@@ -1,0 +1,38 @@
+"""tensorframes_tpu: manipulate columnar dataframes with JAX/XLA programs on TPU.
+
+A TPU-native framework with the capabilities of TensorFrames (the reference
+at ``/root/reference``: TensorFlow-on-Spark-DataFrames). Where the reference
+pairs a Spark cluster with per-partition libtensorflow sessions, this
+framework pairs a columnar table with XLA-compiled programs over a TPU
+device mesh:
+
+- frames are columnar host tables partitioned on the row axis
+  (:mod:`tensorframes_tpu.frame`);
+- user programs are captured JAX functions or a lazy op-builder DSL
+  (:mod:`tensorframes_tpu.capture`), analyzed with ``jax.eval_shape``
+  instead of the reference's driver-side TF shape inference
+  (``TensorFlowOps.scala:101-141``);
+- the engine compiles one XLA program per shape bucket and executes blocks
+  on device (:mod:`tensorframes_tpu.engine`);
+- distribution is a ``jax.sharding.Mesh``: one table shard per chip,
+  reductions ride ICI collectives instead of a driver funnel
+  (:mod:`tensorframes_tpu.parallel`).
+
+Public API parity with the reference's nine functions (``core.py:11-12``):
+``map_blocks, map_rows, reduce_blocks, reduce_rows, aggregate, analyze,
+print_schema, block, row``.
+"""
+
+__version__ = "0.1.0"
+
+from .schema import Shape, Unknown
+from .frame import TensorFrame, GroupedFrame, Row
+
+__all__ = [
+    "Shape",
+    "Unknown",
+    "TensorFrame",
+    "GroupedFrame",
+    "Row",
+    "__version__",
+]

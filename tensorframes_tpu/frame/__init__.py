@@ -1,0 +1,5 @@
+"""Columnar table layer: the TPU-native analog of Spark DataFrames."""
+
+from .table import TensorFrame, GroupedFrame, Row, frame_from_pandas
+
+__all__ = ["TensorFrame", "GroupedFrame", "Row", "frame_from_pandas"]

@@ -1,0 +1,467 @@
+"""Columnar in-memory table: the frame the engine operates on.
+
+The reference operates on Spark DataFrames — distributed, partitioned,
+row-oriented with columnar metadata. The TPU-native analog is a *columnar*
+table: one contiguous host array per column (dense case), partitioned along
+the row axis. Partitions play the same role Spark partitions do in the
+reference (``DebugRowOps.scala:377-391``): ``map_blocks`` runs once per
+partition block, ``reduce_blocks`` produces one partial per partition then
+merges. On device, a partition block maps 1:1 onto a TPU chip's shard (see
+``tensorframes_tpu.parallel``).
+
+Storage forms per column:
+- dense: one ``np.ndarray`` of shape ``[n_rows, *cell_shape]`` — the fast
+  path; feeds the MXU directly after ``device_put``.
+- ragged: a Python list of per-row ``np.ndarray`` cells with a common rank
+  but varying dims (reference supports this in row ops only,
+  ``TFDataOps.scala:90-103``).
+- binary: a Python list of ``bytes`` (reference ``datatypes.scala:571-599``,
+  row ops on single cells only).
+
+Laziness matches the reference: map ops are lazy (``Operations.scala:30-33``,
+materialized by ``collect``/``cache``), reduces are eager.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..schema import (
+    BINARY,
+    ColumnInfo,
+    FrameInfo,
+    Shape,
+    Unknown,
+    for_numpy_dtype,
+)
+
+__all__ = ["Row", "TensorFrame", "GroupedFrame", "frame_from_pandas"]
+
+
+class Row(dict):
+    """A result row: dict with attribute access, printed like the reference's
+    PySpark rows (``README.md:81-90``)."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k) from None
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={_fmt_cell(v)}" for k, v in self.items())
+        return f"Row({inner})"
+
+
+def _fmt_cell(v):
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.floating, np.integer)):
+        return v.item()
+    return v
+
+
+def _as_cell(v) -> Any:
+    """Normalize one cell value to numpy scalar / ndarray / bytes."""
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    if isinstance(v, np.ndarray):
+        return v
+    if isinstance(v, (list, tuple)):
+        return np.asarray(v)
+    return np.asarray(v)[()]  # python scalar -> numpy scalar
+
+
+class _ColumnData:
+    """One column's storage. ``dense`` is an ndarray [n, *cell]; ``cells`` is
+    a list of per-row payloads (ragged / binary)."""
+
+    __slots__ = ("dense", "cells", "is_binary")
+
+    def __init__(self, dense=None, cells=None, is_binary=False):
+        self.dense: Optional[np.ndarray] = dense
+        self.cells: Optional[List[Any]] = cells
+        self.is_binary = is_binary
+
+    @property
+    def num_rows(self) -> int:
+        if self.dense is not None:
+            return int(self.dense.shape[0])
+        return len(self.cells)
+
+    def slice(self, lo: int, hi: int) -> "_ColumnData":
+        if self.dense is not None:
+            return _ColumnData(dense=self.dense[lo:hi])
+        return _ColumnData(cells=self.cells[lo:hi], is_binary=self.is_binary)
+
+    def take(self, idx: np.ndarray) -> "_ColumnData":
+        if self.dense is not None:
+            return _ColumnData(dense=self.dense[idx])
+        return _ColumnData(
+            cells=[self.cells[i] for i in idx], is_binary=self.is_binary
+        )
+
+    def cell(self, i: int):
+        if self.dense is not None:
+            return self.dense[i]
+        return self.cells[i]
+
+    def iter_cells(self):
+        if self.dense is not None:
+            return iter(self.dense)
+        return iter(self.cells)
+
+
+def _build_column(name: str, data) -> Tuple[_ColumnData, ColumnInfo]:
+    """Ingest arbitrary user data into column storage + minimal schema info."""
+    if isinstance(data, _ColumnData):
+        raise TypeError("internal type passed to _build_column")
+    if isinstance(data, np.ndarray):
+        st = for_numpy_dtype(data.dtype)
+        return _ColumnData(dense=np.ascontiguousarray(data)), ColumnInfo(
+            name, st, nesting=data.ndim - 1
+        )
+    data = list(data)
+    if not data:
+        raise ValueError(f"Column {name!r} is empty; cannot infer its type")
+    cells = [_as_cell(v) for v in data]
+    n_binary = sum(isinstance(c, bytes) for c in cells)
+    if n_binary:
+        if n_binary != len(cells):
+            raise TypeError(f"Column {name!r} mixes binary and numeric cells")
+        return _ColumnData(cells=cells, is_binary=True), ColumnInfo(
+            name, BINARY, nesting=0
+        )
+    ranks = {c.ndim for c in cells}
+    if len(ranks) != 1:
+        raise ValueError(
+            f"Column {name!r} has cells of mixed rank {sorted(ranks)}; "
+            f"all cells in a column must have the same tensor order"
+        )
+    rank = ranks.pop()
+    dtype = np.result_type(*[c.dtype for c in cells])
+    st = for_numpy_dtype(dtype)
+    shapes = {c.shape for c in cells}
+    if len(shapes) == 1:
+        dense = np.stack([c.astype(dtype, copy=False) for c in cells])
+        return _ColumnData(dense=np.ascontiguousarray(dense)), ColumnInfo(
+            name, st, nesting=rank
+        )
+    # ragged: keep per-row cells
+    cells = [np.ascontiguousarray(c.astype(dtype, copy=False)) for c in cells]
+    return _ColumnData(cells=cells), ColumnInfo(name, st, nesting=rank)
+
+
+class TensorFrame:
+    """An immutable columnar table with row-axis partitions.
+
+    Construction: :meth:`from_columns`, :meth:`from_rows`,
+    :meth:`from_pandas`, :meth:`from_arrow`.
+    """
+
+    def __init__(
+        self,
+        columns: Dict[str, _ColumnData],
+        info: FrameInfo,
+        num_partitions: int = 1,
+        offsets: Optional[np.ndarray] = None,
+        _thunk: Optional[Callable[[], "TensorFrame"]] = None,
+    ):
+        self._columns = columns
+        self._info = info
+        self._thunk = _thunk  # lazy map pending; None once concrete
+        self._thunk_lock = threading.Lock()
+        if _thunk is not None:
+            self._num_rows = None
+            self._offsets = None
+            self._num_partitions = num_partitions
+            return
+        nrows = {c.num_rows for c in columns.values()}
+        if len(nrows) > 1:
+            raise ValueError(f"Columns have differing lengths: {nrows}")
+        self._num_rows = nrows.pop() if nrows else 0
+        if offsets is not None:
+            self._offsets = np.asarray(offsets, dtype=np.int64)
+            self._num_partitions = len(self._offsets) - 1
+        else:
+            self._num_partitions = max(1, min(num_partitions, max(self._num_rows, 1)))
+            self._offsets = np.linspace(
+                0, self._num_rows, self._num_partitions + 1, dtype=np.int64
+            )
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def from_columns(
+        data: Dict[str, Any], num_partitions: int = 1
+    ) -> "TensorFrame":
+        cols: Dict[str, _ColumnData] = {}
+        infos: List[ColumnInfo] = []
+        for name, v in data.items():
+            cd, ci = _build_column(name, v)
+            cols[name] = cd
+            infos.append(ci)
+        return TensorFrame(cols, FrameInfo(infos), num_partitions=num_partitions)
+
+    @staticmethod
+    def from_rows(
+        rows: Sequence[Dict[str, Any]], num_partitions: int = 1
+    ) -> "TensorFrame":
+        if not rows:
+            raise ValueError("from_rows requires at least one row")
+        names = list(rows[0].keys())
+        data = {n: [r[n] for r in rows] for n in names}
+        return TensorFrame.from_columns(data, num_partitions=num_partitions)
+
+    @staticmethod
+    def from_pandas(pdf, num_partitions: int = 1) -> "TensorFrame":
+        # numeric dtypes come over as one dense array; object columns cell-wise
+        data = {
+            str(c): pdf[c].to_numpy() if pdf[c].dtype != object else list(pdf[c])
+            for c in pdf.columns
+        }
+        return TensorFrame.from_columns(data, num_partitions=num_partitions)
+
+    @staticmethod
+    def from_arrow(table, num_partitions: int = 1) -> "TensorFrame":
+        """Ingest a pyarrow Table (interop edge; reference reads Spark
+        DataFrames, we read Arrow — the common interchange)."""
+        data = {}
+        for name in table.column_names:
+            col = table.column(name)
+            data[name] = col.to_pylist()
+        return TensorFrame.from_columns(data, num_partitions=num_partitions)
+
+    # -- laziness ----------------------------------------------------------
+
+    def _force(self) -> "TensorFrame":
+        """Materialize a lazy frame (one level; thunks may chain)."""
+        if self._thunk is None:
+            return self
+        with self._thunk_lock:
+            if self._thunk is not None:
+                concrete = self._thunk()._force()
+                self._columns = concrete._columns
+                self._num_rows = concrete._num_rows
+                self._offsets = concrete._offsets
+                self._num_partitions = concrete._num_partitions
+                self._thunk = None
+        return self
+
+    @property
+    def is_lazy(self) -> bool:
+        return self._thunk is not None
+
+    def cache(self) -> "TensorFrame":
+        """Force materialization (Spark ``cache()``-ish)."""
+        return self._force()
+
+    # -- schema ------------------------------------------------------------
+
+    @property
+    def schema(self) -> FrameInfo:
+        return self._info
+
+    @property
+    def columns(self) -> List[str]:
+        return self._info.names
+
+    @property
+    def num_rows(self) -> int:
+        self._force()
+        return self._num_rows
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    @property
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def explain_tensors(self) -> str:
+        """Schema + tensor metadata string (reference ``tfs.print_schema`` /
+        ``explain``, ``DebugRowOps.scala:528-545``)."""
+        return self._info.explain()
+
+    # -- data access -------------------------------------------------------
+
+    def column_data(self, name: str) -> _ColumnData:
+        self._force()
+        if name not in self._columns:
+            raise KeyError(f"No column {name!r}; columns: {self.columns}")
+        return self._columns[name]
+
+    def column_block(self, name: str, partition: Optional[int] = None):
+        """The dense block for a column (whole frame or one partition).
+        Raises for ragged/binary columns — those are row-op only, matching
+        the reference (``core.py:287-288``: 'does not work when rows contain
+        vectors of different sizes')."""
+        self._force()
+        cd = self.column_data(name)
+        if cd.dense is None:
+            kind = "binary" if cd.is_binary else "ragged"
+            raise ValueError(
+                f"Column {name!r} is {kind}; block operations require "
+                f"uniform dense columns — use map_rows instead"
+            )
+        if partition is None:
+            return cd.dense
+        lo, hi = self._offsets[partition], self._offsets[partition + 1]
+        return cd.dense[lo:hi]
+
+    def partition_bounds(self) -> List[Tuple[int, int]]:
+        self._force()
+        return [
+            (int(self._offsets[i]), int(self._offsets[i + 1]))
+            for i in range(self._num_partitions)
+        ]
+
+    def collect(self) -> List[Row]:
+        """Materialize to a list of rows (reference ``df.collect()``)."""
+        self._force()
+        names = self.columns
+        iters = [self._columns[n].iter_cells() for n in names]
+        out = []
+        for vals in zip(*iters):
+            out.append(Row(zip(names, vals)))
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+
+        self._force()
+        data = {}
+        for c in self._info:
+            cd = self._columns[c.name]
+            if cd.dense is not None and cd.dense.ndim == 1:
+                data[c.name] = cd.dense
+            else:
+                data[c.name] = list(cd.iter_cells())
+        return pd.DataFrame(data)
+
+    # -- relational-ish ops ------------------------------------------------
+
+    def select(self, *cols: Union[str, Tuple[str, str]]) -> "TensorFrame":
+        """Project columns; a ``(src, alias)`` tuple renames — the analog of
+        the reference's ``df.select(df.y, df.y.alias('z'))``
+        (``README.md:113``)."""
+        self._force()
+        new_cols: Dict[str, _ColumnData] = {}
+        new_infos: List[ColumnInfo] = []
+        for c in cols:
+            src, dst = (c, c) if isinstance(c, str) else c
+            new_cols[dst] = self.column_data(src)
+            new_infos.append(self._info[src].with_name(dst))
+        return TensorFrame(
+            new_cols, FrameInfo(new_infos), offsets=self._offsets
+        )
+
+    def with_column(self, name: str, data) -> "TensorFrame":
+        self._force()
+        cd, ci = _build_column(name, data)
+        if cd.num_rows != self._num_rows:
+            raise ValueError(
+                f"with_column({name!r}): {cd.num_rows} rows != {self._num_rows}"
+            )
+        cols = dict(self._columns)
+        cols[name] = cd
+        infos = [c for c in self._info if c.name != name]
+        infos.append(ci)
+        return TensorFrame(cols, FrameInfo(infos), offsets=self._offsets)
+
+    def repartition(self, n: int) -> "TensorFrame":
+        self._force()
+        return TensorFrame(self._columns, self._info, num_partitions=n)
+
+    def filter_rows(self, mask: np.ndarray) -> "TensorFrame":
+        self._force()
+        idx = np.nonzero(np.asarray(mask))[0]
+        cols = {n: cd.take(idx) for n, cd in self._columns.items()}
+        return TensorFrame(cols, self._info, num_partitions=self._num_partitions)
+
+    def group_by(self, *keys: str) -> "GroupedFrame":
+        self._force()
+        for k in keys:
+            if k not in self._info:
+                raise KeyError(f"group_by: no column {k!r}")
+        return GroupedFrame(self, list(keys))
+
+    # alias matching Spark naming
+    groupBy = group_by
+
+    # -- analysis (reference ``tfs.analyze``) ------------------------------
+
+    def analyze(self) -> "TensorFrame":
+        """Deep per-cell shape analysis; embeds analyzed block shapes in the
+        schema. Mirrors ``ExtraOperations.deepAnalyzeDataFrame``
+        (``ExperimentalOperations.scala:68-111``): per-partition cell-shape
+        merge (mismatched dims -> Unknown), partition size prepended, then a
+        cross-partition merge."""
+        self._force()
+        per_part: List[Optional[List[Optional[Shape]]]] = []
+        for lo, hi in self.partition_bounds():
+            n = hi - lo
+            if n == 0:
+                per_part.append(None)  # empty partitions don't pollute
+                continue
+            col_shapes: List[Optional[Shape]] = []
+            for c in self._info:
+                cd = self._columns[c.name]
+                if cd.is_binary:
+                    col_shapes.append(Shape(n))
+                    continue
+                if cd.dense is not None:
+                    col_shapes.append(Shape((n,) + cd.dense.shape[1:]))
+                    continue
+                merged: Optional[Shape] = None
+                for i in range(lo, hi):
+                    s = Shape(cd.cells[i].shape)
+                    merged = s if merged is None else merged.merge(s)
+                    if merged is None:
+                        break
+                col_shapes.append(
+                    merged.prepend(n) if merged is not None else None
+                )
+            per_part.append(col_shapes)
+        parts = [p for p in per_part if p is not None]
+        if parts:
+            agg = parts[0]
+            for p in parts[1:]:
+                agg = [
+                    (a.merge(b) if a is not None and b is not None else None)
+                    for a, b in zip(agg, p)
+                ]
+        else:
+            agg = [None] * len(self._info)
+        infos = []
+        for c, s in zip(self._info, agg):
+            infos.append(c if s is None else c.with_analyzed(s))
+        return TensorFrame(self._columns, FrameInfo(infos), offsets=self._offsets)
+
+    def __repr__(self):
+        if self._thunk is not None:
+            return f"TensorFrame(lazy, cols={self._info.names})"
+        return (
+            f"TensorFrame(rows={self._num_rows}, parts={self._num_partitions}, "
+            f"cols={self._info.names})"
+        )
+
+
+class GroupedFrame:
+    """Result of ``df.group_by(keys)``; consumed by ``tfs.aggregate``
+    (analog of Spark's ``RelationalGroupedDataset``,
+    reference ``DebugRowOps.scala:547-592``)."""
+
+    def __init__(self, frame: TensorFrame, keys: List[str]):
+        self.frame = frame
+        self.keys = keys
+
+    def __repr__(self):
+        return f"GroupedFrame(keys={self.keys}, frame={self.frame!r})"
+
+
+def frame_from_pandas(pdf, num_partitions: int = 1) -> TensorFrame:
+    return TensorFrame.from_pandas(pdf, num_partitions=num_partitions)

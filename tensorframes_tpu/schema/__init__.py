@@ -1,0 +1,55 @@
+"""Schema core: shapes, scalar types, column/frame metadata.
+
+TPU-native analog of the reference's L1 schema layer
+(``/root/reference/src/main/scala/org/tensorframes/{Shape,ColumnInformation,
+DataFrameInfo,MetadataConstants}.scala``).
+"""
+
+from .shape import Shape, Unknown, HighDimException
+from .dtypes import (
+    ScalarType,
+    FLOAT64,
+    FLOAT32,
+    BFLOAT16,
+    FLOAT16,
+    INT64,
+    INT32,
+    INT8,
+    UINT8,
+    BOOL,
+    BINARY,
+    REFERENCE_PARITY_TYPES,
+    supported_types,
+    for_numpy_dtype,
+    for_any,
+    for_name,
+    has_ops,
+)
+from .column_info import ColumnInfo, TensorInfo
+from .frame_info import FrameInfo
+
+__all__ = [
+    "Shape",
+    "Unknown",
+    "HighDimException",
+    "ScalarType",
+    "FLOAT64",
+    "FLOAT32",
+    "BFLOAT16",
+    "FLOAT16",
+    "INT64",
+    "INT32",
+    "INT8",
+    "UINT8",
+    "BOOL",
+    "BINARY",
+    "REFERENCE_PARITY_TYPES",
+    "supported_types",
+    "for_numpy_dtype",
+    "for_any",
+    "for_name",
+    "has_ops",
+    "ColumnInfo",
+    "TensorInfo",
+    "FrameInfo",
+]

@@ -1,0 +1,119 @@
+"""Per-column tensor metadata.
+
+Analog of the reference's ``ColumnInformation`` + ``SparkTFColInfo``
+(``/root/reference/src/main/scala/org/tensorframes/ColumnInformation.scala:8-139``,
+``Shape.scala:120-123``). The reference smuggles tensor info through Spark's
+``StructField.metadata`` under the keys ``org.spartf.shape`` /
+``org.sparktf.type`` (``MetadataConstants.scala:9-21``); here columns are
+first-class objects so the info lives directly on :class:`ColumnInfo`.
+
+Conventions (identical to the reference):
+- ``block_shape`` always includes the leading row dimension, usually
+  ``Unknown`` (number of rows in a block is not statically known).
+- ``cell_shape`` is ``block_shape.tail()``: the shape of one row's payload.
+- a column with no analyzed info still has a *minimal* shape inferred from
+  its storage nesting: each ragged/list nesting level contributes an
+  ``Unknown`` dim (``ColumnInformation.scala:99-126``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .dtypes import ScalarType
+from .shape import Shape, Unknown
+
+__all__ = ["ColumnInfo", "TensorInfo"]
+
+#: metadata keys, kept for (de)serialization parity with the reference
+#: (``MetadataConstants.scala:15-21``).
+SHAPE_KEY = "tfs_tpu.shape"
+TYPE_KEY = "tfs_tpu.type"
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorInfo:
+    """shape + scalar type of a column's tensor content (analog of
+    ``SparkTFColInfo``, reference ``Shape.scala:120-123``). ``shape`` is the
+    block shape (lead dim = rows)."""
+
+    shape: Shape
+    scalar_type: ScalarType
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnInfo:
+    """A named column plus (optionally analyzed) tensor info."""
+
+    name: str
+    scalar_type: ScalarType
+    #: analyzed block shape; ``None`` when only the storage-level minimal
+    #: shape is known (reference: absent metadata).
+    analyzed_shape: Optional[Shape] = None
+    #: number of list-nesting levels in the storage (0 = scalar column,
+    #: 1 = vector column, ...); determines the minimal shape.
+    nesting: int = 0
+    nullable: bool = False
+
+    @property
+    def block_shape(self) -> Shape:
+        """The best-known block shape: analyzed if available, else minimal
+        from storage nesting with all dims Unknown
+        (reference ``ColumnInformation.scala:99-126``)."""
+        if self.analyzed_shape is not None:
+            return self.analyzed_shape
+        return Shape([Unknown] * (self.nesting + 1))
+
+    @property
+    def cell_shape(self) -> Shape:
+        return self.block_shape.tail()
+
+    @property
+    def tensor_info(self) -> TensorInfo:
+        return TensorInfo(self.block_shape, self.scalar_type)
+
+    def with_analyzed(self, shape: Shape) -> "ColumnInfo":
+        return dataclasses.replace(self, analyzed_shape=shape)
+
+    def with_name(self, name: str) -> "ColumnInfo":
+        return dataclasses.replace(self, name=name)
+
+    # -- explain formatting (matches reference print_schema output style,
+    # -- e.g. " |-- y: array (nullable = false) DoubleType[?,2]") ----------
+
+    def sql_kind(self) -> str:
+        if self.scalar_type.name == "binary":
+            return "binary"
+        if self.nesting == 0:
+            return self.scalar_type.sql_name.replace("Type", "").lower()
+        return "array"
+
+    def explain_line(self) -> str:
+        shape = self.block_shape
+        return (
+            f" |-- {self.name}: {self.sql_kind()} "
+            f"(nullable = {str(self.nullable).lower()}) "
+            f"{self.scalar_type.sql_name}{shape}"
+        )
+
+    # -- metadata round-trip (parity with the reference's metadata embed,
+    # -- ``ColumnInformation.scala:35-56``) --------------------------------
+
+    def to_metadata(self) -> dict:
+        md = {TYPE_KEY: self.scalar_type.name, "nesting": self.nesting}
+        if self.analyzed_shape is not None:
+            md[SHAPE_KEY] = list(self.analyzed_shape.dims)
+        return md
+
+    @staticmethod
+    def from_metadata(name: str, md: dict) -> "ColumnInfo":
+        from .dtypes import for_name
+
+        shape = Shape(md[SHAPE_KEY]) if SHAPE_KEY in md else None
+        return ColumnInfo(
+            name=name,
+            scalar_type=for_name(md[TYPE_KEY]),
+            analyzed_shape=shape,
+            nesting=int(md.get("nesting", 0)),
+        )

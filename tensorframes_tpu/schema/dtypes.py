@@ -1,0 +1,194 @@
+"""Scalar-type registry.
+
+TPU-native analog of the reference's ``SupportedOperations`` registry
+(``/root/reference/src/main/scala/org/tensorframes/impl/datatypes.scala:27-52,
+265-324``), which maps every supported scalar between four type systems
+(Spark SQL, protobuf, TF-Java, an internal ADT). Here the systems are simpler:
+Python scalars / numpy dtypes / JAX dtypes / an internal :class:`ScalarType`.
+
+Reference parity set: float64, float32, int32, int64, binary
+(``datatypes.scala:265-267``) — binary supports row ops on single cells only
+(``datatypes.scala:578-599``). TPU-first extras beyond the reference:
+bfloat16 (the MXU-native dtype), float16, bool, int8/uint8 — these exist so
+user programs can down-cast into the fast path without leaving the framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "ScalarType",
+    "FLOAT64",
+    "FLOAT32",
+    "BFLOAT16",
+    "FLOAT16",
+    "INT64",
+    "INT32",
+    "INT8",
+    "UINT8",
+    "BOOL",
+    "BINARY",
+    "REFERENCE_PARITY_TYPES",
+    "supported_types",
+    "for_numpy_dtype",
+    "for_any",
+    "for_name",
+    "has_ops",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarType:
+    """One supported scalar type (analog of ``ScalarTypeOperation[T]``,
+    reference ``datatypes.scala:60-152``).
+
+    Attributes:
+        name: canonical short name (also the SQL-ish name used in ``explain``).
+        np_dtype: the numpy dtype backing host buffers, or ``None`` for binary.
+        supports_blocks: False for types that only work in row ops on single
+            cells (binary; reference ``datatypes.scala:578-581``).
+        is_64bit: needs ``jax_enable_x64`` on device.
+        sql_name: pretty name used by the schema printer, matching the
+            reference's Spark-SQL names in ``print_schema`` output.
+    """
+
+    name: str
+    np_dtype: Optional[np.dtype]
+    supports_blocks: bool = True
+    is_64bit: bool = False
+    sql_name: str = ""
+
+    def __post_init__(self):
+        if not self.sql_name:
+            object.__setattr__(self, "sql_name", self.name)
+
+    @property
+    def jax_dtype(self):
+        """The on-device dtype. Import is deferred so the schema core stays
+        importable without initializing a JAX backend."""
+        if self.np_dtype is None:
+            raise TypeError(f"{self.name} has no device dtype")
+        if self.name == "bfloat16":
+            import jax.numpy as jnp
+
+            return jnp.bfloat16
+        return self.np_dtype
+
+    def zero(self) -> Any:
+        if self.np_dtype is None:
+            return b""
+        return self.np_dtype.type(0)
+
+    def __repr__(self) -> str:
+        return f"ScalarType({self.name})"
+
+
+def _np(x) -> np.dtype:
+    return np.dtype(x)
+
+
+FLOAT64 = ScalarType("float64", _np(np.float64), is_64bit=True, sql_name="DoubleType")
+FLOAT32 = ScalarType("float32", _np(np.float32), sql_name="FloatType")
+# np.dtype for bfloat16 comes from ml_dtypes (vendored by jax); fall back to
+# float32 host storage if unavailable.
+try:
+    import ml_dtypes as _ml_dtypes
+
+    _BF16_NP: Optional[np.dtype] = _np(_ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16_NP = None
+BFLOAT16 = ScalarType("bfloat16", _BF16_NP or _np(np.float32), sql_name="BFloat16Type")
+FLOAT16 = ScalarType("float16", _np(np.float16), sql_name="HalfType")
+INT64 = ScalarType("int64", _np(np.int64), is_64bit=True, sql_name="LongType")
+INT32 = ScalarType("int32", _np(np.int32), sql_name="IntegerType")
+INT8 = ScalarType("int8", _np(np.int8), sql_name="ByteType")
+UINT8 = ScalarType("uint8", _np(np.uint8), sql_name="UByteType")
+BOOL = ScalarType("bool", _np(np.bool_), sql_name="BooleanType")
+BINARY = ScalarType("binary", None, supports_blocks=False, sql_name="BinaryType")
+
+#: The exact set the reference supports (``datatypes.scala:265-267``).
+REFERENCE_PARITY_TYPES = (FLOAT64, FLOAT32, INT32, INT64, BINARY)
+
+_ALL = (
+    FLOAT64,
+    FLOAT32,
+    BFLOAT16,
+    FLOAT16,
+    INT64,
+    INT32,
+    INT8,
+    UINT8,
+    BOOL,
+    BINARY,
+)
+
+_BY_NAME: Dict[str, ScalarType] = {t.name: t for t in _ALL}
+_BY_NP: Dict[np.dtype, ScalarType] = {}
+for _t in _ALL:
+    if _t.np_dtype is not None and _t.np_dtype not in _BY_NP:
+        _BY_NP[_t.np_dtype] = _t
+
+
+def supported_types():
+    """All registered scalar types (analog of
+    ``MetadataConstants.supportedTypes``, reference
+    ``MetadataConstants.scala:23-33``)."""
+    return _ALL
+
+
+def for_name(name: str) -> ScalarType:
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise KeyError(
+            f"Unknown scalar type {name!r}; supported: {sorted(_BY_NAME)}"
+        ) from None
+
+
+def for_numpy_dtype(dt) -> ScalarType:
+    dt = np.dtype(dt)
+    try:
+        return _BY_NP[dt]
+    except KeyError:
+        raise KeyError(
+            f"numpy dtype {dt} is not supported by tensorframes_tpu; "
+            f"supported: {sorted(t.name for t in _ALL)}"
+        ) from None
+
+
+def for_any(x) -> ScalarType:
+    """Resolve a ScalarType from any of: ScalarType, name, numpy dtype,
+    python scalar/value (analog of the multi-keyed lookups in reference
+    ``datatypes.scala:275-315``)."""
+    if isinstance(x, ScalarType):
+        return x
+    if isinstance(x, str):
+        # may be a type name or a numpy dtype string
+        if x in _BY_NAME:
+            return _BY_NAME[x]
+        return for_numpy_dtype(x)
+    if isinstance(x, (bytes, bytearray)):
+        return BINARY
+    if isinstance(x, bool):
+        return BOOL
+    if isinstance(x, int):
+        return INT64
+    if isinstance(x, float):
+        return FLOAT64
+    if hasattr(x, "dtype"):
+        return for_numpy_dtype(x.dtype)
+    return for_numpy_dtype(x)
+
+
+def has_ops(x) -> bool:
+    """True if ``x`` is a scalar value of a supported type (analog of
+    ``SupportedOperations.hasOps``, reference ``datatypes.scala:292-298``)."""
+    try:
+        for_any(x)
+        return True
+    except (KeyError, TypeError):
+        return False

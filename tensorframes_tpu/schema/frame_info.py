@@ -1,0 +1,57 @@
+"""Whole-frame schema view + pretty printer.
+
+Analog of the reference's ``DataFrameInfo``
+(``/root/reference/src/main/scala/org/tensorframes/DataFrameInfo.scala:7-39``)
+and the ``explain`` output consumed by ``tfs.print_schema``
+(``DebugRowOps.scala:528-545``, ``core.py:351-360``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from .column_info import ColumnInfo
+
+__all__ = ["FrameInfo"]
+
+
+class FrameInfo:
+    """Ordered collection of :class:`ColumnInfo` for one frame."""
+
+    def __init__(self, cols: Sequence[ColumnInfo]):
+        self.cols: List[ColumnInfo] = list(cols)
+        names = [c.name for c in self.cols]
+        if len(set(names)) != len(names):
+            raise ValueError(f"Duplicate column names: {names}")
+
+    def __iter__(self):
+        return iter(self.cols)
+
+    def __len__(self):
+        return len(self.cols)
+
+    def __getitem__(self, name: str) -> ColumnInfo:
+        for c in self.cols:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return any(c.name == name for c in self.cols)
+
+    @property
+    def names(self) -> List[str]:
+        return [c.name for c in self.cols]
+
+    def explain(self) -> str:
+        """Schema string in the reference's ``print_schema`` format
+        (cf. ``README.md:105-108``)::
+
+            root
+             |-- y: array (nullable = false) DoubleType[?,2]
+        """
+        lines = ["root"] + [c.explain_line() for c in self.cols]
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        return f"FrameInfo({', '.join(f'{c.name}:{c.scalar_type.name}{c.block_shape}' for c in self.cols)})"

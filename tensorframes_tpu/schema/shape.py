@@ -1,0 +1,172 @@
+"""Tensor shapes with unknown dimensions.
+
+TPU-native analog of the reference's shape core
+(``/root/reference/src/main/scala/org/tensorframes/Shape.scala:16-109``).
+The reference models every column as a tensor whose leading dimension is the
+(unknown) number of rows; unknown dims are encoded as ``-1``.
+
+On TPU the distinction matters more than it did on the reference's CPU path:
+XLA compiles one program per concrete shape, so ``Unknown`` dims mark exactly
+the axes the engine must bucket/pad (see ``tensorframes_tpu.engine``) or make
+symbolic (see ``tensorframes_tpu.capture.serialize``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple, Union
+
+from numpy import integer as _np_integer
+
+__all__ = ["Unknown", "Shape", "HighDimException"]
+
+#: Sentinel for an unknown dimension (reference ``Shape.scala:88-89``).
+Unknown: int = -1
+
+
+class HighDimException(ValueError):
+    """Raised when a tensor of unsupported order is requested.
+
+    Mirrors ``HighDimException`` (reference ``Shape.scala:129-130``): cell
+    payloads are limited to order <= 2 (scalars, vectors, matrices), matching
+    the reference's converter support (``datatypes.scala:123-124``,
+    ``DataOps.scala:162-165``).
+    """
+
+    def __init__(self, shape: "Shape"):
+        self.shape = shape
+        super().__init__(
+            f"Shape {shape} is too high-dimensional - tensorframes_tpu only "
+            f"supports cell tensors of order <= 2 (matrices)"
+        )
+
+
+class Shape:
+    """An N-d tensor shape where each dim is a non-negative int or ``Unknown``.
+
+    Immutable and hashable. Analog of reference ``Shape``
+    (``Shape.scala:16-109``), with the same operations: ``prepend``, ``tail``,
+    ``drop_inner``, ``num_elements``, ``check_more_precise_than``.
+    """
+
+    __slots__ = ("_dims",)
+
+    def __init__(self, *dims: Union[int, Iterable[int]]):
+        if len(dims) == 1 and not isinstance(dims[0], (int, _np_integer)):
+            dims = tuple(dims[0])  # Shape([1, 2]) / Shape((1, 2))
+        ds = []
+        for d in dims:
+            d = int(d)
+            if d < -1:
+                raise ValueError(f"Shape dims must be >= -1, got {d} in {dims}")
+            ds.append(d)
+        self._dims: Tuple[int, ...] = tuple(ds)
+
+    # -- accessors ---------------------------------------------------------
+
+    @property
+    def dims(self) -> Tuple[int, ...]:
+        return self._dims
+
+    @property
+    def num_dims(self) -> int:
+        return len(self._dims)
+
+    @property
+    def has_unknown(self) -> bool:
+        return Unknown in self._dims
+
+    @property
+    def num_elements(self) -> Optional[int]:
+        """Total element count, or ``None`` if any dim is unknown
+        (reference ``Shape.scala:28``)."""
+        if self.has_unknown:
+            return None
+        n = 1
+        for d in self._dims:
+            n *= d
+        return n
+
+    # -- transforms --------------------------------------------------------
+
+    def prepend(self, x: int) -> "Shape":
+        """Shape with an extra leading dimension (``Shape.scala:37-39``)."""
+        return Shape((int(x),) + self._dims)
+
+    def tail(self) -> "Shape":
+        """Shape with the first dimension dropped (``Shape.scala:49``)."""
+        return Shape(self._dims[1:])
+
+    def drop_inner(self) -> "Shape":
+        """Shape with the innermost dimension dropped (``Shape.scala:44``)."""
+        return Shape(self._dims[:-1])
+
+    def with_lead(self, x: int) -> "Shape":
+        """Shape with the leading dimension replaced by ``x``."""
+        if not self._dims:
+            raise ValueError("cannot replace lead dim of a scalar shape")
+        return Shape((int(x),) + self._dims[1:])
+
+    # -- predicates --------------------------------------------------------
+
+    def check_more_precise_than(self, other: "Shape") -> bool:
+        """True if ``self`` is a valid refinement of ``other``: same rank, and
+        every dim of ``other`` is either ``Unknown`` or equal
+        (reference ``Shape.scala:54-59``)."""
+        if self.num_dims != other.num_dims:
+            return False
+        return all(b == Unknown or b == a for a, b in zip(self._dims, other._dims))
+
+    def merge(self, other: "Shape") -> Optional["Shape"]:
+        """Dim-wise merge used by ``analyze``: equal dims kept, mismatched dims
+        become ``Unknown``; rank mismatch yields ``None``
+        (reference ``ExperimentalOperations.scala:147-157``)."""
+        if self.num_dims != other.num_dims:
+            return None
+        return Shape(
+            a if a == b else Unknown for a, b in zip(self._dims, other._dims)
+        )
+
+    # -- conversions -------------------------------------------------------
+
+    def to_concrete(self, fill: int = 1) -> Tuple[int, ...]:
+        """Concrete tuple with unknowns replaced by ``fill`` (for probing)."""
+        return tuple(fill if d == Unknown else d for d in self._dims)
+
+    def to_jax(self) -> Tuple[Optional[int], ...]:
+        """JAX/numpy convention: unknowns become ``None``."""
+        return tuple(None if d == Unknown else d for d in self._dims)
+
+    @staticmethod
+    def from_jax(dims: Sequence[Optional[int]]) -> "Shape":
+        """From the ``None``-for-unknown convention (numpy/TF/JAX style)."""
+        return Shape(Unknown if d is None else int(d) for d in dims)
+
+    @staticmethod
+    def empty() -> "Shape":
+        """The scalar shape (rank 0; reference ``Shape.scala:91``)."""
+        return Shape()
+
+    # -- dunder ------------------------------------------------------------
+
+    def __iter__(self):
+        return iter(self._dims)
+
+    def __len__(self) -> int:
+        return len(self._dims)
+
+    def __getitem__(self, i):
+        return self._dims[i]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Shape):
+            return self._dims == other._dims
+        if isinstance(other, (tuple, list)):
+            return self._dims == tuple(other)
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(self._dims)
+
+    def __repr__(self) -> str:
+        inner = ",".join("?" if d == Unknown else str(d) for d in self._dims)
+        return f"[{inner}]"

@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Tests run on CPU with a virtual 8-device mesh so multi-chip sharding logic
+(`tensorframes_tpu.parallel`) is exercised without TPU hardware, mirroring
+how the reference tests distribution semantics on a `local[1]` Spark master
+with explicit multi-partition RDDs
+(`/root/reference/src/test/scala/org/tensorframes/TensorFlossTestSparkContext.scala:10-43`).
+
+Env vars must be set before jax initializes its backends, hence here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,143 @@
+"""TensorFrame columnar-table tests, incl. the analyze() semantics of the
+reference (`ExtraOperationsSuite.scala:15-98`)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.frame import Row, TensorFrame
+from tensorframes_tpu.schema import Shape, Unknown
+
+
+def test_from_columns_dense_scalar():
+    df = TensorFrame.from_columns({"x": np.arange(10.0)})
+    assert df.num_rows == 10
+    assert df.columns == ["x"]
+    assert df.schema["x"].scalar_type.name == "float64"
+    assert df.schema["x"].block_shape == Shape(Unknown)
+
+
+def test_from_rows_and_collect():
+    rows = [dict(x=float(i)) for i in range(5)]
+    df = TensorFrame.from_rows(rows)
+    out = df.collect()
+    assert [r.x for r in out] == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert repr(out[0]) == "Row(x=0.0)"
+
+
+def test_vector_column_dense():
+    df = TensorFrame.from_columns({"y": [[1.0, -1.0], [2.0, -2.0]]})
+    assert df.schema["y"].nesting == 1
+    block = df.column_block("y")
+    assert block.shape == (2, 2)
+
+
+def test_ragged_column():
+    df = TensorFrame.from_columns({"y": [[1.0], [2.0, 3.0]]})
+    cd = df.column_data("y")
+    assert cd.dense is None
+    with pytest.raises(ValueError, match="ragged"):
+        df.column_block("y")
+
+
+def test_binary_column():
+    df = TensorFrame.from_columns({"b": [b"ab", b"cde"]})
+    assert df.schema["b"].scalar_type.name == "binary"
+    with pytest.raises(ValueError, match="binary"):
+        df.column_block("b")
+
+
+def test_mixed_rank_rejected():
+    with pytest.raises(ValueError, match="mixed rank"):
+        TensorFrame.from_columns({"y": [1.0, [2.0, 3.0]]})
+
+
+def test_partitions():
+    df = TensorFrame.from_columns({"x": np.arange(10)}, num_partitions=3)
+    bounds = df.partition_bounds()
+    assert len(bounds) == 3
+    assert bounds[0][0] == 0 and bounds[-1][1] == 10
+    total = sum(hi - lo for lo, hi in bounds)
+    assert total == 10
+    p0 = df.column_block("x", 0)
+    assert p0.tolist() == list(range(bounds[0][0], bounds[0][1]))
+
+
+def test_partitions_capped_at_rows():
+    df = TensorFrame.from_columns({"x": np.arange(2)}, num_partitions=5)
+    assert df.num_partitions == 2
+
+
+def test_select_and_alias():
+    df = TensorFrame.from_columns({"y": [[1.0, 2.0]]})
+    df2 = df.select("y", ("y", "z"))
+    assert df2.columns == ["y", "z"]
+    assert np.array_equal(df2.column_block("z"), df.column_block("y"))
+
+
+def test_with_column():
+    df = TensorFrame.from_columns({"x": np.arange(3.0)})
+    df2 = df.with_column("z", np.arange(3.0) * 2)
+    assert set(df2.columns) == {"x", "z"}
+    with pytest.raises(ValueError, match="rows"):
+        df.with_column("bad", np.arange(5.0))
+
+
+def test_repartition():
+    df = TensorFrame.from_columns({"x": np.arange(10)}).repartition(4)
+    assert df.num_partitions == 4
+
+
+def test_to_pandas_roundtrip():
+    pd = pytest.importorskip("pandas")
+    pdf = pd.DataFrame({"x": [1.0, 2.0], "y": [[1, 2], [3, 4]]})
+    df = TensorFrame.from_pandas(pdf)
+    back = df.to_pandas()
+    assert list(back["x"]) == [1.0, 2.0]
+    assert [list(v) for v in back["y"]] == [[1, 2], [3, 4]]
+
+
+class TestAnalyze:
+    # reference ExtraOperationsSuite.scala:15-98
+
+    def test_scalar(self):
+        df = TensorFrame.from_columns({"x": np.arange(4.0)}).analyze()
+        # single partition of 4 rows -> lead dim known
+        assert df.schema["x"].block_shape == Shape(4)
+
+    def test_vector_uniform(self):
+        df = TensorFrame.from_columns(
+            {"y": [[float(i), float(-i)] for i in range(10)]}
+        ).analyze()
+        assert df.schema["y"].block_shape == Shape(10, 2)
+        assert df.schema["y"].cell_shape == Shape(2)
+
+    def test_vector_multi_partition_lead_unknown(self):
+        # 3 partitions of differing sizes -> lead dim merges to Unknown
+        df = TensorFrame.from_columns(
+            {"y": [[float(i)] for i in range(10)]}, num_partitions=3
+        ).analyze()
+        assert df.schema["y"].block_shape == Shape(Unknown, 1)
+
+    def test_ragged_merges_to_unknown(self):
+        df = TensorFrame.from_columns({"y": [[1.0], [2.0, 3.0]]}).analyze()
+        assert df.schema["y"].block_shape == Shape(2, Unknown)
+
+    def test_print_schema_like_readme(self):
+        # README.md:105-108
+        df = TensorFrame.from_columns(
+            {"y": [[float(i), float(-i)] for i in range(10)]}, num_partitions=2
+        ).analyze()
+        line = df.explain_tensors()
+        assert "DoubleType[?,2]" in line or "DoubleType[5,2]" in line
+
+
+def test_group_by_unknown_key():
+    df = TensorFrame.from_columns({"x": np.arange(3)})
+    with pytest.raises(KeyError):
+        df.group_by("nope")
+
+
+def test_filter_rows():
+    df = TensorFrame.from_columns({"x": np.arange(5.0)})
+    df2 = df.filter_rows(np.array([True, False, True, False, True]))
+    assert [r.x for r in df2.collect()] == [0.0, 2.0, 4.0]

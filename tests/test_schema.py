@@ -1,0 +1,157 @@
+"""Schema-core tests (analog of the reference's shape/metadata unit tests)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.schema import (
+    BINARY,
+    FLOAT32,
+    FLOAT64,
+    INT32,
+    INT64,
+    ColumnInfo,
+    FrameInfo,
+    Shape,
+    Unknown,
+    for_any,
+    for_name,
+    for_numpy_dtype,
+    has_ops,
+)
+
+
+class TestShape:
+    def test_basic(self):
+        s = Shape(2, 3)
+        assert s.num_dims == 2
+        assert s.dims == (2, 3)
+        assert s.num_elements == 6
+        assert not s.has_unknown
+
+    def test_unknown(self):
+        s = Shape(Unknown, 3)
+        assert s.has_unknown
+        assert s.num_elements is None
+        assert repr(s) == "[?,3]"
+
+    def test_empty_scalar(self):
+        s = Shape.empty()
+        assert s.num_dims == 0
+        assert s.num_elements == 1
+
+    def test_prepend_tail_drop(self):
+        s = Shape(3)
+        assert s.prepend(5) == Shape(5, 3)
+        assert Shape(5, 3).tail() == Shape(3)
+        assert Shape(5, 3).drop_inner() == Shape(5)
+
+    def test_from_iterable(self):
+        assert Shape([2, 3]) == Shape(2, 3)
+        assert Shape((2,)) == Shape(2)
+
+    # reference Shape.scala:54-59
+    def test_more_precise(self):
+        assert Shape(5, 3).check_more_precise_than(Shape(Unknown, 3))
+        assert Shape(5, 3).check_more_precise_than(Shape(5, 3))
+        assert not Shape(5, 3).check_more_precise_than(Shape(5, 4))
+        assert not Shape(5, 3).check_more_precise_than(Shape(3))
+        assert Shape(Unknown).check_more_precise_than(Shape(Unknown))
+
+    # reference ExperimentalOperations.scala:147-157
+    def test_merge(self):
+        assert Shape(2, 3).merge(Shape(2, 3)) == Shape(2, 3)
+        assert Shape(2, 3).merge(Shape(2, 4)) == Shape(2, Unknown)
+        assert Shape(2, 3).merge(Shape(3)) is None
+
+    def test_invalid_dim(self):
+        with pytest.raises(ValueError):
+            Shape(-2)
+
+    def test_jax_roundtrip(self):
+        s = Shape(Unknown, 4)
+        assert s.to_jax() == (None, 4)
+        assert Shape.from_jax((None, 4)) == s
+        assert s.to_concrete(fill=7) == (7, 4)
+
+    def test_hash_eq(self):
+        assert Shape(1, 2) == (1, 2)
+        assert hash(Shape(1, 2)) == hash(Shape(1, 2))
+        d = {Shape(1, 2): "a"}
+        assert d[Shape(1, 2)] == "a"
+
+
+class TestDtypes:
+    def test_registry_lookup(self):
+        assert for_numpy_dtype(np.float64) is FLOAT64
+        assert for_numpy_dtype("int32") is INT32
+        assert for_name("float32") is FLOAT32
+
+    def test_for_any(self):
+        assert for_any(3.0) is FLOAT64
+        assert for_any(3) is INT64
+        assert for_any(b"abc") is BINARY
+        assert for_any(np.zeros(3, np.int32)) is INT32
+        assert for_any("int64") is INT64
+        assert for_any(INT32) is INT32
+
+    def test_has_ops(self):
+        assert has_ops(1.5)
+        assert has_ops(np.int32(2))
+        assert not has_ops(object())
+
+    def test_binary_no_blocks(self):
+        assert not BINARY.supports_blocks
+        assert FLOAT64.supports_blocks
+
+    def test_unsupported(self):
+        with pytest.raises(KeyError):
+            for_numpy_dtype(np.complex128)
+
+
+class TestColumnInfo:
+    def test_minimal_shape_from_nesting(self):
+        c = ColumnInfo("x", FLOAT64, nesting=0)
+        assert c.block_shape == Shape(Unknown)
+        assert c.cell_shape == Shape.empty()
+        c2 = ColumnInfo("y", FLOAT64, nesting=1)
+        assert c2.block_shape == Shape(Unknown, Unknown)
+
+    def test_analyzed_overrides(self):
+        c = ColumnInfo("y", FLOAT64, nesting=1).with_analyzed(Shape(Unknown, 2))
+        assert c.block_shape == Shape(Unknown, 2)
+        assert c.cell_shape == Shape(2)
+
+    def test_metadata_roundtrip(self):
+        c = ColumnInfo("y", INT64, analyzed_shape=Shape(Unknown, 2), nesting=1)
+        md = c.to_metadata()
+        c2 = ColumnInfo.from_metadata("y", md)
+        assert c2 == c
+
+    def test_explain_line_format(self):
+        # matches the reference README's print_schema sample (README.md:105-108)
+        c = ColumnInfo("y", FLOAT64, analyzed_shape=Shape(Unknown, 2), nesting=1)
+        assert c.explain_line() == " |-- y: array (nullable = false) DoubleType[?,2]"
+
+
+class TestFrameInfo:
+    def test_explain(self):
+        fi = FrameInfo(
+            [
+                ColumnInfo("x", FLOAT64, nesting=0),
+                ColumnInfo("y", INT32, analyzed_shape=Shape(10, 2), nesting=1),
+            ]
+        )
+        out = fi.explain()
+        assert out.startswith("root\n")
+        assert "|-- x:" in out and "IntegerType[10,2]" in out
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError):
+            FrameInfo([ColumnInfo("x", FLOAT64), ColumnInfo("x", FLOAT32)])
+
+    def test_lookup(self):
+        fi = FrameInfo([ColumnInfo("x", FLOAT64)])
+        assert fi["x"].scalar_type is FLOAT64
+        assert "x" in fi and "z" not in fi
+        with pytest.raises(KeyError):
+            fi["z"]
