@@ -27,12 +27,74 @@ __version__ = "0.1.0"
 
 from .schema import Shape, Unknown
 from .frame import TensorFrame, GroupedFrame, Row
+from .engine import (
+    map_blocks,
+    map_rows,
+    reduce_blocks,
+    reduce_rows,
+    aggregate,
+    analyze,
+    print_schema,
+    explain,
+    block,
+    row,
+    InputNotFoundError,
+    InvalidTypeError,
+    InvalidDimensionError,
+    OutputCollisionError,
+)
+from .capture import (
+    CapturedGraph,
+    Node,
+    graph,
+    scope,
+    placeholder,
+    constant,
+    build_graph,
+    apply_op,
+    serialize_graph,
+    deserialize_graph,
+    save_graph,
+    load_graph,
+    functions,
+)
 
 __all__ = [
+    # the reference's nine public functions (core.py:11-12)
+    "map_blocks",
+    "map_rows",
+    "reduce_blocks",
+    "reduce_rows",
+    "aggregate",
+    "analyze",
+    "print_schema",
+    "block",
+    "row",
+    # frames & schema
     "Shape",
     "Unknown",
     "TensorFrame",
     "GroupedFrame",
     "Row",
+    "explain",
+    # capture layer
+    "CapturedGraph",
+    "Node",
+    "graph",
+    "scope",
+    "placeholder",
+    "constant",
+    "build_graph",
+    "apply_op",
+    "serialize_graph",
+    "deserialize_graph",
+    "save_graph",
+    "load_graph",
+    "functions",
+    # errors
+    "InputNotFoundError",
+    "InvalidTypeError",
+    "InvalidDimensionError",
+    "OutputCollisionError",
     "__version__",
 ]

@@ -1,0 +1,42 @@
+"""Program capture: DSL, function frontend, analysis, serialization.
+
+TPU-native analog of the reference's graph layer — GraphDef construction
+(``dsl/``), driver-side analysis (``TensorFlowOps.analyzeGraphTF``) and
+serialized interchange (``SerializedGraph``).
+"""
+
+from .graph import CapturedGraph, TensorSpec, GraphNodeSummary, analysis_specs
+from .dsl import (
+    Node,
+    graph,
+    scope,
+    placeholder,
+    block,
+    row,
+    constant,
+    build_graph,
+    apply_op,
+)
+from .serialize import serialize_graph, deserialize_graph, save_graph, load_graph
+from . import functions
+
+__all__ = [
+    "CapturedGraph",
+    "TensorSpec",
+    "GraphNodeSummary",
+    "analysis_specs",
+    "Node",
+    "graph",
+    "scope",
+    "placeholder",
+    "block",
+    "row",
+    "constant",
+    "build_graph",
+    "apply_op",
+    "serialize_graph",
+    "deserialize_graph",
+    "save_graph",
+    "load_graph",
+    "functions",
+]

@@ -1,0 +1,336 @@
+"""Lazy op-builder DSL.
+
+Analog of the reference's Scala DSL
+(``/root/reference/src/main/scala/org/tensorframes/dsl/``): users build a
+small graph of named nodes from frame columns, then hand fetches to
+``map_blocks``/``reduce_blocks``/etc. Nodes here lower to ``jax.numpy``
+calls evaluated inside one jitted program, so the "graph" is only a naming
+and wiring layer — XLA does the real graph work.
+
+Naming follows the reference (``dsl/Paths.scala:40-55``): per-graph
+auto-numbered op names (``add``, ``add_1``, ...) with ``/``-joined scopes;
+unlike the reference's explicitly non-thread-safe global state
+(``Paths.scala:10-12``), graph state here is thread-local.
+
+The auto-placeholder helpers ``block(df, col)`` / ``row(df, col)`` mirror
+``tfs.block``/``tfs.row`` (reference ``core.py:397-450``): shape inferred
+from column metadata; block lead dim is always Unknown (``core.py:446-449``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..schema import ScalarType, Shape, Unknown, for_any
+from .graph import CapturedGraph, TensorSpec
+
+__all__ = [
+    "Node",
+    "graph",
+    "scope",
+    "placeholder",
+    "block",
+    "row",
+    "constant",
+    "build_graph",
+    "apply_op",
+]
+
+
+class _GraphState:
+    def __init__(self):
+        self.counters: Dict[str, int] = {}
+        self.scopes: List[str] = []
+
+    def fresh(self, base: str) -> str:
+        path = "/".join(self.scopes + [base])
+        n = self.counters.get(path, 0)
+        self.counters[path] = n + 1
+        return path if n == 0 else f"{path}_{n}"
+
+    def scoped(self, name: str) -> str:
+        return "/".join(self.scopes + [name])
+
+
+_tls = threading.local()
+
+
+def _state() -> _GraphState:
+    st = getattr(_tls, "state", None)
+    if st is None:
+        st = _GraphState()
+        _tls.state = st
+    return st
+
+
+@contextlib.contextmanager
+def graph():
+    """Fresh name-counter scope (analog of ``tf.withGraph``,
+    reference ``dsl/package.scala:31-35``). Recommended around each op to
+    keep auto-numbering deterministic."""
+    old = getattr(_tls, "state", None)
+    _tls.state = _GraphState()
+    try:
+        yield
+    finally:
+        _tls.state = old
+
+
+@contextlib.contextmanager
+def scope(name: str):
+    """Name scope (reference ``dsl/package.scala:22-28``)."""
+    st = _state()
+    st.scopes.append(name)
+    try:
+        yield
+    finally:
+        st.scopes.pop()
+
+
+class Node:
+    """One lazy op. ``fn`` consumes the parents' values (jnp arrays) and
+    produces this node's value; placeholders/constants carry metadata
+    instead (analog of reference ``dsl/Operation.scala:15-58``)."""
+
+    __slots__ = ("name", "op_name", "parents", "fn", "ph_spec", "value", "__weakref__")
+
+    #: numpy must defer to Node's reflected operators instead of
+    #: broadcasting elementwise into an object array of Nodes
+    __array_ufunc__ = None
+
+    def __init__(
+        self,
+        op_name: str,
+        parents: Sequence["Node"],
+        fn: Optional[Callable],
+        name: Optional[str] = None,
+        ph_spec: Optional[TensorSpec] = None,
+        value: Optional[np.ndarray] = None,
+    ):
+        self.op_name = op_name
+        self.parents = list(parents)
+        self.fn = fn
+        self.ph_spec = ph_spec
+        self.value = value
+        self.name = _state().scoped(name) if name else _state().fresh(op_name)
+
+    # -- naming ------------------------------------------------------------
+
+    def named(self, name: str) -> "Node":
+        """Rename (reference ``named``, ``dsl/Operation.scala:44-47``).
+        Placeholder renames also rebind the placeholder name; the column
+        binding (original column) is preserved via inputs_map at capture."""
+        self.name = _state().scoped(name)
+        return self
+
+    @property
+    def is_placeholder(self) -> bool:
+        return self.ph_spec is not None
+
+    # -- operators ---------------------------------------------------------
+
+    def __add__(self, o):
+        return _binop("add", self, o, lambda a, b: a + b)
+
+    def __radd__(self, o):
+        return _binop("add", o, self, lambda a, b: a + b)
+
+    def __sub__(self, o):
+        return _binop("sub", self, o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return _binop("sub", o, self, lambda a, b: a - b)
+
+    def __mul__(self, o):
+        return _binop("mul", self, o, lambda a, b: a * b)
+
+    def __rmul__(self, o):
+        return _binop("mul", o, self, lambda a, b: a * b)
+
+    def __truediv__(self, o):
+        return _binop("div", self, o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return _binop("div", o, self, lambda a, b: a / b)
+
+    def __pow__(self, o):
+        return _binop("pow", self, o, lambda a, b: a**b)
+
+    def __neg__(self):
+        return apply_op(lambda a: -a, self, op_name="neg")
+
+    def __matmul__(self, o):
+        return _binop("matmul", self, o, lambda a, b: a @ b)
+
+    def __getitem__(self, idx):
+        return apply_op(lambda a: a[idx], self, op_name="slice")
+
+    def __repr__(self):
+        kind = "ph" if self.is_placeholder else self.op_name
+        return f"Node({self.name}: {kind})"
+
+
+#: Python/numpy scalars stay *literals* closed over by the op function, so
+#: JAX weak-type promotion applies (``int32_col * 2`` stays int32) — the
+#: same no-implicit-widening behavior the reference gets from TF constants.
+_LITERAL_TYPES = (int, float, bool, np.integer, np.floating, np.bool_)
+
+
+def _lift(x) -> Node:
+    if isinstance(x, Node):
+        return x
+    return constant(x)
+
+
+def apply_op(
+    f: Callable, *parents: Union[Node, Any], op_name: str = "op", name: Optional[str] = None
+) -> Node:
+    """Escape hatch: any jnp-traceable function of the parent values becomes
+    a node. This is how the DSL stays small while XLA's op set stays fully
+    reachable (the reference instead hand-maintains NodeDef builders,
+    ``dsl/DslImpl.scala:143-200``)."""
+    node_parents: List[Node] = []
+    slots: List = []  # per-arg: (True, node_index) or (False, literal)
+    for p in parents:
+        if isinstance(p, Node):
+            slots.append((True, len(node_parents)))
+            node_parents.append(p)
+        elif isinstance(p, _LITERAL_TYPES):
+            slots.append((False, p))
+        else:
+            slots.append((True, len(node_parents)))
+            node_parents.append(constant(p))
+
+    def g(*vals):
+        args = [vals[s[1]] if s[0] else s[1] for s in slots]
+        return f(*args)
+
+    return Node(op_name, node_parents, g, name=name)
+
+
+def _binop(op_name: str, a, b, f: Callable) -> Node:
+    return apply_op(f, a, b, op_name=op_name)
+
+
+# -- placeholders & constants ---------------------------------------------
+
+
+def placeholder(
+    dtype, shape: Union[Shape, Sequence[int]], name: Optional[str] = None
+) -> Node:
+    """Explicit placeholder with a declared (block or cell) shape; dims may
+    be Unknown/-1/None (reference ``dsl/package.scala:60-66``)."""
+    st = for_any(dtype)
+    if not isinstance(shape, Shape):
+        shape = Shape.from_jax(tuple(shape))
+    n = Node("placeholder", [], None, name=name)
+    n.ph_spec = TensorSpec(n.name, st, shape)
+    return n
+
+
+def block(df, col_name: str, tft_name: Optional[str] = None) -> Node:
+    """Placeholder bound to a column, with *block* shape (lead dim Unknown —
+    reference ``core.py:446-449``: lead is always None so empty/variable
+    partitions are accepted)."""
+    info = df.schema[col_name]
+    shape = info.block_shape.with_lead(Unknown)
+    n = placeholder(info.scalar_type, shape, name=tft_name or col_name)
+    _set_bound_column(n, col_name)  # renames keep binding to the column
+    return n
+
+
+def row(df, col_name: str, tft_name: Optional[str] = None) -> Node:
+    """Placeholder bound to a column with *cell* (one-row) shape
+    (reference ``core.py:412-425``)."""
+    info = df.schema[col_name]
+    n = placeholder(info.scalar_type, info.cell_shape, name=tft_name or col_name)
+    _set_bound_column(n, col_name)
+    return n
+
+
+def constant(value, dtype=None, name: Optional[str] = None) -> Node:
+    """Embedded constant (reference ``dsl/package.scala:68-75``,
+    ``DenseTensor.scala:18-116``); becomes an XLA constant after jit."""
+    arr = np.asarray(value, dtype=None if dtype is None else np.dtype(dtype))
+    return Node("constant", [], None, name=name, value=arr)
+
+
+# Node uses __slots__; the optional column binding lives in a side table.
+_bound_columns: "weakref.WeakKeyDictionary[Node, str]" = weakref.WeakKeyDictionary()
+
+
+def _set_bound_column(node: Node, col: str) -> None:
+    _bound_columns[node] = col
+
+
+def bound_column(node: Node) -> Optional[str]:
+    return _bound_columns.get(node)
+
+
+# -- capture ---------------------------------------------------------------
+
+
+def build_graph(fetches: Union[Node, Sequence[Node]]) -> CapturedGraph:
+    """Freeze a DSL DAG into a :class:`CapturedGraph` (analog of
+    ``DslImpl.buildGraph``, reference ``dsl/DslImpl.scala:38-75``).
+
+    Placeholders become named inputs; fetch node names become output/column
+    names; a placeholder created via ``block``/``row`` keeps its original
+    column binding in ``inputs_map`` even if renamed."""
+    if isinstance(fetches, Node):
+        fetches = [fetches]
+    fetches = list(fetches)
+
+    # transitive closure, deterministic order
+    seen: Dict[int, Node] = {}
+    order: List[Node] = []
+
+    def visit(n: Node):
+        stack = [(n, False)]
+        while stack:
+            node, done = stack.pop()
+            if done:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen[id(node)] = node
+            stack.append((node, True))
+            for p in reversed(node.parents):
+                stack.append((p, False))
+
+    for f in fetches:
+        visit(f)
+
+    placeholders: List[TensorSpec] = []
+    inputs_map: Dict[str, str] = {}
+    for n in order:
+        if n.is_placeholder:
+            spec = TensorSpec(n.name, n.ph_spec.scalar_type, n.ph_spec.shape)
+            placeholders.append(spec)
+            col = bound_column(n)
+            inputs_map[n.name] = col if col is not None else n.name
+
+    node_list = list(order)
+
+    def fn(feed: Dict[str, Any]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        memo: Dict[int, Any] = {}
+        for n in node_list:
+            if n.is_placeholder:
+                memo[id(n)] = feed[n.name]
+            elif n.value is not None:
+                memo[id(n)] = jnp.asarray(n.value)
+            else:
+                memo[id(n)] = n.fn(*[memo[id(p)] for p in n.parents])
+        return {f.name: memo[id(f)] for f in fetches}
+
+    return CapturedGraph(
+        fn, placeholders, [f.name for f in fetches], inputs_map
+    )

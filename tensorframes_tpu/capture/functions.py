@@ -1,0 +1,293 @@
+"""DSL function library.
+
+Analog of the reference's TF-python-lookalike package object
+(``/root/reference/src/main/scala/org/tensorframes/dsl/package.scala:16-132``:
+``placeholder, constant, zeros, ones, fill, identity, add, div, reduce_min,
+reduce_sum``) — extended well beyond it, since each entry here is one line
+over ``jax.numpy`` instead of a hand-built NodeDef emitter. Anything not
+listed is reachable via :func:`tensorframes_tpu.capture.dsl.apply_op`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from .dsl import Node, apply_op, constant, _lift
+
+__all__ = [
+    "identity",
+    "add",
+    "sub",
+    "mul",
+    "div",
+    "minimum",
+    "maximum",
+    "matmul",
+    "exp",
+    "log",
+    "sqrt",
+    "square",
+    "abs_",
+    "neg",
+    "tanh",
+    "sigmoid",
+    "relu",
+    "softmax",
+    "cast",
+    "reshape",
+    "transpose",
+    "concat",
+    "stack",
+    "reduce_sum",
+    "reduce_min",
+    "reduce_max",
+    "reduce_mean",
+    "reduce_prod",
+    "argmin",
+    "argmax",
+    "greater",
+    "less",
+    "equal",
+    "where",
+    "zeros",
+    "ones",
+    "fill",
+    "unsorted_segment_sum",
+    "expand_dims",
+    "squeeze",
+]
+
+
+def _axis_tuple(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def identity(x, name: Optional[str] = None) -> Node:
+    return apply_op(lambda a: a, x, op_name="identity", name=name)
+
+
+def add(x, y, name: Optional[str] = None) -> Node:
+    return apply_op(lambda a, b: a + b, x, y, op_name="add", name=name)
+
+
+def sub(x, y, name: Optional[str] = None) -> Node:
+    return apply_op(lambda a, b: a - b, x, y, op_name="sub", name=name)
+
+
+def mul(x, y, name: Optional[str] = None) -> Node:
+    return apply_op(lambda a, b: a * b, x, y, op_name="mul", name=name)
+
+
+def div(x, y, name: Optional[str] = None) -> Node:
+    return apply_op(lambda a, b: a / b, x, y, op_name="div", name=name)
+
+
+def minimum(x, y, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(jnp.minimum, x, y, op_name="minimum", name=name)
+
+
+def maximum(x, y, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(jnp.maximum, x, y, op_name="maximum", name=name)
+
+
+def matmul(x, y, name: Optional[str] = None) -> Node:
+    return apply_op(lambda a, b: a @ b, x, y, op_name="matmul", name=name)
+
+
+def _unary(jnp_name: str, op_name: str):
+    def f(x, name: Optional[str] = None) -> Node:
+        import jax.numpy as jnp
+
+        return apply_op(getattr(jnp, jnp_name), x, op_name=op_name, name=name)
+
+    f.__name__ = op_name
+    return f
+
+
+exp = _unary("exp", "exp")
+log = _unary("log", "log")
+sqrt = _unary("sqrt", "sqrt")
+square = _unary("square", "square")
+abs_ = _unary("abs", "abs")
+neg = _unary("negative", "neg")
+tanh = _unary("tanh", "tanh")
+
+
+def sigmoid(x, name: Optional[str] = None) -> Node:
+    import jax
+
+    return apply_op(jax.nn.sigmoid, x, op_name="sigmoid", name=name)
+
+
+def relu(x, name: Optional[str] = None) -> Node:
+    import jax
+
+    return apply_op(jax.nn.relu, x, op_name="relu", name=name)
+
+
+def softmax(x, axis: int = -1, name: Optional[str] = None) -> Node:
+    import jax
+
+    return apply_op(
+        lambda a: jax.nn.softmax(a, axis=axis), x, op_name="softmax", name=name
+    )
+
+
+def cast(x, dtype, name: Optional[str] = None) -> Node:
+    from ..schema import for_any
+
+    st = for_any(dtype)
+    return apply_op(
+        lambda a: a.astype(st.jax_dtype), x, op_name="cast", name=name
+    )
+
+
+def reshape(x, shape: Sequence[int], name: Optional[str] = None) -> Node:
+    shp = tuple(int(s) for s in shape)
+    return apply_op(lambda a: a.reshape(shp), x, op_name="reshape", name=name)
+
+
+def transpose(x, axes=None, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(
+        lambda a: jnp.transpose(a, axes), x, op_name="transpose", name=name
+    )
+
+
+def concat(xs: Sequence, axis: int = 0, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(
+        lambda *vs: jnp.concatenate(vs, axis=axis),
+        *xs,
+        op_name="concat",
+        name=name,
+    )
+
+
+def stack(xs: Sequence, axis: int = 0, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(
+        lambda *vs: jnp.stack(vs, axis=axis), *xs, op_name="stack", name=name
+    )
+
+
+def _reducer(jnp_name: str, op_name: str):
+    def f(x, axis=None, keepdims: bool = False, name: Optional[str] = None) -> Node:
+        import jax.numpy as jnp
+
+        ax = _axis_tuple(axis)
+        return apply_op(
+            lambda a: getattr(jnp, jnp_name)(a, axis=ax, keepdims=keepdims),
+            x,
+            op_name=op_name,
+            name=name,
+        )
+
+    f.__name__ = op_name
+    return f
+
+
+reduce_sum = _reducer("sum", "reduce_sum")
+reduce_min = _reducer("min", "reduce_min")
+reduce_max = _reducer("max", "reduce_max")
+reduce_mean = _reducer("mean", "reduce_mean")
+reduce_prod = _reducer("prod", "reduce_prod")
+
+
+def argmin(x, axis: int = 0, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(
+        lambda a: jnp.argmin(a, axis=axis).astype(jnp.int32),
+        x,
+        op_name="argmin",
+        name=name,
+    )
+
+
+def argmax(x, axis: int = 0, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(
+        lambda a: jnp.argmax(a, axis=axis).astype(jnp.int32),
+        x,
+        op_name="argmax",
+        name=name,
+    )
+
+
+def greater(x, y, name: Optional[str] = None) -> Node:
+    return apply_op(lambda a, b: a > b, x, y, op_name="greater", name=name)
+
+
+def less(x, y, name: Optional[str] = None) -> Node:
+    return apply_op(lambda a, b: a < b, x, y, op_name="less", name=name)
+
+
+def equal(x, y, name: Optional[str] = None) -> Node:
+    return apply_op(lambda a, b: a == b, x, y, op_name="equal", name=name)
+
+
+def where(cond, x, y, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(jnp.where, cond, x, y, op_name="where", name=name)
+
+
+def zeros(shape: Sequence[int], dtype=np.float64, name: Optional[str] = None) -> Node:
+    return constant(np.zeros(tuple(shape), dtype=np.dtype(dtype)), name=name)
+
+
+def ones(shape: Sequence[int], dtype=np.float64, name: Optional[str] = None) -> Node:
+    return constant(np.ones(tuple(shape), dtype=np.dtype(dtype)), name=name)
+
+
+def fill(shape: Sequence[int], value, name: Optional[str] = None) -> Node:
+    arr = np.full(tuple(shape), value)
+    return constant(arr, name=name)
+
+
+def unsorted_segment_sum(
+    data, segment_ids, num_segments: int, name: Optional[str] = None
+) -> Node:
+    """Segment sum with a static segment count — the op the reference's
+    optimized k-means uses to pre-aggregate inside the graph
+    (``kmeans_demo.py:128-146``). Lowers to ``jax.ops.segment_sum``."""
+    import jax
+
+    return apply_op(
+        lambda d, s: jax.ops.segment_sum(d, s, num_segments=num_segments),
+        data,
+        segment_ids,
+        op_name="unsorted_segment_sum",
+        name=name,
+    )
+
+
+def expand_dims(x, axis: int = 0, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(
+        lambda a: jnp.expand_dims(a, axis), x, op_name="expand_dims", name=name
+    )
+
+
+def squeeze(x, axis=None, name: Optional[str] = None) -> Node:
+    import jax.numpy as jnp
+
+    return apply_op(
+        lambda a: jnp.squeeze(a, axis=axis), x, op_name="squeeze", name=name
+    )

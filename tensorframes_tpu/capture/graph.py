@@ -1,0 +1,375 @@
+"""Captured tensor programs and their analysis.
+
+Analog of the reference's graph runtime
+(``/root/reference/src/main/scala/org/tensorframes/impl/TensorFlowOps.scala``):
+where the reference ships a protobuf ``GraphDef`` and asks the TF C++ runtime
+for per-node dtypes/shapes on the driver (``analyzeGraphTF``,
+``TensorFlowOps.scala:101-141``), this build captures a JAX-traceable
+function plus named input specs, and derives output dtypes/shapes with
+``jax.eval_shape`` — abstract tracing, no device work, no data.
+
+Unknown dimensions are handled with JAX shape polymorphism: all block lead
+dims share one symbolic size (they are the same physical row count), other
+unknown dims get fresh symbols. This replaces the reference's
+``ShapeDescription`` hint side-channel (``ShapeDescription.scala:12-20``),
+which existed because TF >= 1.0 pruned dynamic shapes from serialized graphs.
+Hints remain supported as overrides for programs XLA cannot trace
+polymorphically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..schema import ColumnInfo, ScalarType, Shape, Unknown, for_numpy_dtype
+from ..utils import ensure_x64, get_logger
+
+__all__ = [
+    "TensorSpec",
+    "GraphNodeSummary",
+    "CapturedGraph",
+    "analysis_specs",
+]
+
+logger = get_logger("capture")
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """A named tensor endpoint: placeholder (input) or fetch (output)."""
+
+    name: str
+    scalar_type: ScalarType
+    shape: Shape
+
+    def __repr__(self):
+        return f"{self.name}:{self.scalar_type.name}{self.shape}"
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphNodeSummary:
+    """Driver-side node summary (analog of ``GraphNodeSummary``, reference
+    ``TensorFlowOps.scala:163-169``)."""
+
+    is_input: bool
+    is_output: bool
+    scalar_type: ScalarType
+    shape: Shape
+    name: str
+
+
+def _sds(shape: Tuple, dtype) -> Any:
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _symbolic_shapes(
+    specs: Sequence[TensorSpec], share_lead: bool
+) -> List[Tuple]:
+    """Build concrete-or-symbolic dim tuples for eval_shape.
+
+    All Unknown *lead* dims share one symbol (the block row count) when
+    ``share_lead``; every other Unknown dim gets a fresh symbol. All symbols
+    are created in a single ``symbolic_shape`` call so they share one JAX
+    symbolic scope (mixing scopes is an error)."""
+    from jax import export
+
+    # first pass: plan symbol names per (spec, axis)
+    plan: List[List[Any]] = []
+    names: List[str] = []
+    lead_name: Optional[str] = None
+    for spec in specs:
+        dims: List[Any] = []
+        for axis, d in enumerate(spec.shape.dims):
+            if d != Unknown:
+                dims.append(int(d))
+            elif axis == 0 and share_lead:
+                if lead_name is None:
+                    lead_name = "_tfs_b"
+                    names.append(lead_name)
+                dims.append(lead_name)
+            else:
+                nm = f"_tfs_d{len(names)}"
+                names.append(nm)
+                dims.append(nm)
+        plan.append(dims)
+    if not names:
+        return [tuple(dims) for dims in plan]
+    syms = export.symbolic_shape(", ".join(names))
+    by_name = dict(zip(names, syms))
+    return [
+        tuple(by_name[d] if isinstance(d, str) else d for d in dims)
+        for dims in plan
+    ]
+
+
+def _shape_from_abstract(dims: Tuple) -> Shape:
+    """Map eval_shape output dims back to Shape (symbolic -> Unknown)."""
+    out = []
+    for d in dims:
+        if isinstance(d, (int, np.integer)):
+            out.append(int(d))
+        else:
+            out.append(Unknown)  # symbolic expression
+    return Shape(out)
+
+
+class CapturedGraph:
+    """A user tensor program captured for the engine.
+
+    Attributes:
+        fn: ``fn(feed: dict[placeholder_name, array]) -> dict[fetch, array]``,
+            JAX-traceable (pure, jnp ops, static shapes inside).
+        placeholders: ordered input specs, by placeholder name.
+        fetch_names: requested output names (become result column names,
+            matching the reference's rule that fetches name the new columns,
+            ``Operations.scala:29-31``).
+        inputs_map: placeholder name -> frame column name (the reference's
+            feed_dict / ``builder.inputs``, ``PythonInterface.scala:120-127``).
+        shape_hints: optional fetch-name -> Shape overrides
+            (``ShapeDescription`` analog).
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[Dict[str, Any]], Dict[str, Any]],
+        placeholders: Sequence[TensorSpec],
+        fetch_names: Sequence[str],
+        inputs_map: Optional[Dict[str, str]] = None,
+        shape_hints: Optional[Dict[str, Shape]] = None,
+    ):
+        self.fn = fn
+        self.placeholders: Dict[str, TensorSpec] = {p.name: p for p in placeholders}
+        if len(self.placeholders) != len(placeholders):
+            raise ValueError(
+                f"Duplicate placeholder names: {[p.name for p in placeholders]}"
+            )
+        self.fetch_names = list(fetch_names)
+        if len(set(self.fetch_names)) != len(self.fetch_names):
+            # reference: core.py:105-107
+            raise ValueError(
+                f"Could not infer a list of unique names for the columns: "
+                f"{self.fetch_names}"
+            )
+        self.inputs_map = dict(inputs_map or {})
+        for ph in self.placeholders:
+            self.inputs_map.setdefault(ph, ph)  # core.py:134-136
+        self.shape_hints = dict(shape_hints or {})
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def from_callable(
+        fn: Callable,
+        input_specs: Dict[str, Tuple[ScalarType, Shape]],
+        fetch_names: Optional[Sequence[str]] = None,
+        inputs_map: Optional[Dict[str, str]] = None,
+        shape_hints: Optional[Dict[str, Shape]] = None,
+        probe_feed: Optional[Dict[str, Any]] = None,
+    ) -> "CapturedGraph":
+        """Capture a plain Python function whose keyword args are placeholder
+        names and whose return value is a dict of named outputs (or a single
+        array when exactly one fetch name is given).
+
+        ``probe_feed``: concrete sample inputs used to discover output names
+        when abstract tracing is impossible (binary/host-path programs)."""
+        phs = [TensorSpec(n, st, sh) for n, (st, sh) in input_specs.items()]
+
+        def wrapped(feed: Dict[str, Any]) -> Dict[str, Any]:
+            out = fn(**{n: feed[n] for n in input_specs})
+            if isinstance(out, dict):
+                return out
+            if fetch_names is not None and len(fetch_names) == 1:
+                return {fetch_names[0]: out}
+            raise TypeError(
+                "captured function must return a dict of named outputs "
+                "(or pass fetch_names=[single_name])"
+            )
+
+        if fetch_names is not None:
+            fetch_names_ = list(fetch_names)
+        elif probe_feed is not None:
+            out = wrapped(probe_feed)
+            if not isinstance(out, dict):
+                raise TypeError(
+                    "captured function must return a dict of named outputs"
+                )
+            fetch_names_ = list(out.keys())
+        else:
+            fetch_names_ = _probe_fetch_names(wrapped, phs)
+        return CapturedGraph(
+            wrapped, phs, fetch_names_, inputs_map, shape_hints
+        )
+
+    # -- analysis (analog of analyzeGraphTF) -------------------------------
+
+    def analyze(
+        self,
+        input_shapes: Optional[Dict[str, Shape]] = None,
+        share_lead: bool = True,
+    ) -> Dict[str, TensorSpec]:
+        """Infer fetch dtypes/shapes by abstract tracing.
+
+        ``input_shapes`` refines placeholder shapes (e.g. with a frame's
+        analyzed block shapes). Returns fetch name -> TensorSpec. Shape hints
+        override inference, mirroring how the reference lets hint shapes win
+        (``TensorFlowOps.scala:126-133``)."""
+        import jax
+
+        specs = []
+        for ph in self.placeholders.values():
+            shape = (input_shapes or {}).get(ph.name, ph.shape)
+            specs.append(TensorSpec(ph.name, ph.scalar_type, shape))
+        if any(s.scalar_type.is_64bit for s in specs):
+            ensure_x64()
+        try:
+            shapes = _symbolic_shapes(specs, share_lead)
+            feed = {
+                s.name: _sds(shp, s.scalar_type.jax_dtype)
+                for s, shp in zip(specs, shapes)
+            }
+            out = jax.eval_shape(self.fn, feed)
+        except Exception as e:
+            logger.debug("symbolic analysis failed (%s); concrete probe", e)
+            out = self._concrete_probe(specs)
+        result: Dict[str, TensorSpec] = {}
+        for name in self.fetch_names:
+            if name not in out:
+                raise KeyError(
+                    f"Fetch {name!r} not among program outputs {sorted(out)}"
+                )
+            o = out[name]
+            shape = (
+                self.shape_hints[name]
+                if name in self.shape_hints
+                else _shape_from_abstract(o.shape)
+            )
+            result[name] = TensorSpec(name, for_numpy_dtype(o.dtype), shape)
+        return result
+
+    def _concrete_probe(self, specs: Sequence[TensorSpec]):
+        """Fallback when polymorphic tracing fails: trace once with concrete
+        stand-in sizes. Unknown dims are filled with distinct primes so output
+        dims that inherited them can be detected and re-marked Unknown."""
+        import jax
+
+        primes = iter([13, 7, 5, 3, 11, 17, 19, 23, 29, 31])
+        lead_fill: Optional[int] = None  # Unknown lead dims share one size
+        fill_values: set = set()
+        feed = {}
+        for s in specs:
+            dims = []
+            for axis, d in enumerate(s.shape.dims):
+                if d != Unknown:
+                    dims.append(d)
+                elif axis == 0:
+                    if lead_fill is None:
+                        lead_fill = next(primes)
+                        fill_values.add(lead_fill)
+                    dims.append(lead_fill)
+                else:
+                    f = next(primes)
+                    fill_values.add(f)
+                    dims.append(f)
+            feed[s.name] = _sds(tuple(dims), s.scalar_type.jax_dtype)
+        out = jax.eval_shape(self.fn, feed)
+
+        class _O:
+            def __init__(self, shape, dtype):
+                self.shape = shape
+                self.dtype = dtype
+
+        # dims equal to a fill size inherited an Unknown input dim; None is
+        # the non-int marker _shape_from_abstract maps back to Unknown.
+        return {
+            k: _O(tuple(None if d in fill_values else d for d in v.shape), v.dtype)
+            for k, v in out.items()
+        }
+
+    def node_summaries(
+        self, input_shapes: Optional[Dict[str, Shape]] = None
+    ) -> List[GraphNodeSummary]:
+        """Input+output summaries (reference ``analyzeGraphTF`` result,
+        ``TensorFlowOps.scala:101-141``)."""
+        outs = self.analyze(input_shapes)
+        res = [
+            GraphNodeSummary(True, False, p.scalar_type, p.shape, p.name)
+            for p in self.placeholders.values()
+        ]
+        res += [
+            GraphNodeSummary(False, True, o.scalar_type, o.shape, o.name)
+            for o in outs.values()
+        ]
+        return res
+
+    # -- helpers -----------------------------------------------------------
+
+    def with_inputs(self, feed_dict: Dict[str, str]) -> "CapturedGraph":
+        """Merge a user feed_dict (placeholder -> column), analog of
+        ``_add_inputs`` (reference ``core.py:127-141``)."""
+        merged = dict(self.inputs_map)
+        for k, v in feed_dict.items():
+            if k not in self.placeholders:
+                raise KeyError(
+                    f"feed_dict names unknown placeholder {k!r}; "
+                    f"placeholders: {sorted(self.placeholders)}"
+                )
+            merged[k] = v
+        return CapturedGraph(
+            self.fn,
+            list(self.placeholders.values()),
+            self.fetch_names,
+            merged,
+            self.shape_hints,
+        )
+
+    def with_hints(self, hints: Dict[str, Shape]) -> "CapturedGraph":
+        return CapturedGraph(
+            self.fn,
+            list(self.placeholders.values()),
+            self.fetch_names,
+            self.inputs_map,
+            {**self.shape_hints, **hints},
+        )
+
+    def __repr__(self):
+        return (
+            f"CapturedGraph(inputs={list(self.placeholders)}, "
+            f"fetches={self.fetch_names})"
+        )
+
+
+def _probe_fetch_names(
+    wrapped: Callable, phs: Sequence[TensorSpec]
+) -> List[str]:
+    """Discover output names by abstract-tracing once with stand-in shapes."""
+    import jax
+
+    if any(p.scalar_type.is_64bit for p in phs):
+        ensure_x64()
+    feed = {
+        p.name: _sds(p.shape.to_concrete(fill=2), p.scalar_type.jax_dtype)
+        for p in phs
+    }
+    out = jax.eval_shape(wrapped, feed)
+    if not isinstance(out, dict):
+        raise TypeError("captured function must return a dict of named outputs")
+    return list(out.keys())
+
+
+def analysis_specs(
+    cols: Sequence[ColumnInfo], block: bool
+) -> Dict[str, Tuple[ScalarType, Shape]]:
+    """Input specs for a frame's columns: block shape (lead Unknown) for
+    block ops, cell shape for row ops (reference ``_auto_placeholder``,
+    ``core.py:427-450``)."""
+    specs = {}
+    for c in cols:
+        shape = c.block_shape.with_lead(Unknown) if block else c.cell_shape
+        specs[c.name] = (c.scalar_type, shape)
+    return specs

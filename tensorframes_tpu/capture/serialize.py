@@ -1,0 +1,100 @@
+"""Program serialization: the interchange/load path.
+
+Analog of the reference's ``SerializedGraph`` byte-array graphs
+(``/root/reference/src/main/scala/org/tensorframes/impl/TensorFlowOps.scala:21-74``)
+and the graph-file load path (``PythonInterface.scala:110-118``,
+``core.py:57-68``). The artifact here is a StableHLO program produced by
+``jax.export`` with a symbolic batch dimension, plus a JSON header carrying
+the placeholder/fetch specs and input map — everything an executor needs to
+run the program without the Python that built it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from ..schema import Shape, for_name
+from ..utils import ensure_x64
+from .graph import CapturedGraph, TensorSpec, _symbolic_shapes
+
+__all__ = ["serialize_graph", "deserialize_graph", "save_graph", "load_graph"]
+
+_MAGIC = b"TFSTPU1\x00"
+
+
+def serialize_graph(
+    graph: CapturedGraph,
+    input_shapes: Optional[Dict[str, Shape]] = None,
+) -> bytes:
+    """Export to bytes. Unknown lead dims become one shared symbolic size,
+    so the artifact runs on any block length without recompilation at the
+    StableHLO level (XLA still specializes per concrete shape at run time)."""
+    import jax
+    from jax import export
+
+    specs = []
+    for ph in graph.placeholders.values():
+        shape = (input_shapes or {}).get(ph.name, ph.shape)
+        specs.append(TensorSpec(ph.name, ph.scalar_type, shape))
+    if any(s.scalar_type.is_64bit for s in specs):
+        ensure_x64()
+    shapes = _symbolic_shapes(specs, share_lead=True)
+    feed = {
+        s.name: jax.ShapeDtypeStruct(shp, s.scalar_type.jax_dtype)
+        for s, shp in zip(specs, shapes)
+    }
+    exported = export.export(jax.jit(graph.fn))(feed)
+    payload = exported.serialize()
+    header = json.dumps(
+        {
+            "version": 1,
+            "placeholders": [
+                [s.name, s.scalar_type.name, list(s.shape.dims)] for s in specs
+            ],
+            "fetches": graph.fetch_names,
+            "inputs_map": graph.inputs_map,
+            "shape_hints": {
+                k: list(v.dims) for k, v in graph.shape_hints.items()
+            },
+        }
+    ).encode("utf-8")
+    return _MAGIC + len(header).to_bytes(8, "little") + header + bytes(payload)
+
+
+def deserialize_graph(data: bytes) -> CapturedGraph:
+    """Rebuild a :class:`CapturedGraph` whose ``fn`` calls the deserialized
+    StableHLO program."""
+    from jax import export
+
+    if not data.startswith(_MAGIC):
+        raise ValueError("Not a tensorframes_tpu serialized graph")
+    off = len(_MAGIC)
+    hlen = int.from_bytes(data[off : off + 8], "little")
+    header = json.loads(data[off + 8 : off + 8 + hlen].decode("utf-8"))
+    payload = data[off + 8 + hlen :]
+    exported = export.deserialize(bytearray(payload))
+    phs = [
+        TensorSpec(name, for_name(st), Shape(dims))
+        for name, st, dims in header["placeholders"]
+    ]
+    if any(p.scalar_type.is_64bit for p in phs):
+        ensure_x64()
+
+    def fn(feed: Dict[str, object]) -> Dict[str, object]:
+        return exported.call(feed)
+
+    hints = {k: Shape(v) for k, v in header.get("shape_hints", {}).items()}
+    return CapturedGraph(
+        fn, phs, header["fetches"], header["inputs_map"], hints
+    )
+
+
+def save_graph(graph: CapturedGraph, path: str, **kw) -> None:
+    with open(path, "wb") as f:
+        f.write(serialize_graph(graph, **kw))
+
+
+def load_graph(path: str) -> CapturedGraph:
+    with open(path, "rb") as f:
+        return deserialize_graph(f.read())

@@ -1,0 +1,37 @@
+"""Local execution engine: the nine-function public API over one device."""
+
+from .ops import (
+    map_blocks,
+    map_rows,
+    reduce_blocks,
+    reduce_rows,
+    aggregate,
+    analyze,
+    print_schema,
+    explain,
+    block,
+    row,
+)
+from .validation import (
+    InputNotFoundError,
+    InvalidTypeError,
+    InvalidDimensionError,
+    OutputCollisionError,
+)
+
+__all__ = [
+    "map_blocks",
+    "map_rows",
+    "reduce_blocks",
+    "reduce_rows",
+    "aggregate",
+    "analyze",
+    "print_schema",
+    "explain",
+    "block",
+    "row",
+    "InputNotFoundError",
+    "InvalidTypeError",
+    "InvalidDimensionError",
+    "OutputCollisionError",
+]

@@ -1,0 +1,657 @@
+"""The local (single-host) execution engine: the nine-function public API.
+
+Analog of the reference's ``DebugRowOps`` execution engine
+(``/root/reference/src/main/scala/org/tensorframes/impl/DebugRowOps.scala:281-593``)
+re-designed for XLA:
+
+- where the reference opens a TF ``Session`` per Spark task and feeds NIO
+  buffers through JNI (``performMap``, ``DebugRowOps.scala:766-803``), this
+  engine jits the captured program once and executes it per partition block;
+  XLA's jit cache plays the role of the broadcast graph + session pool;
+- where the reference merges reduce partials two rows at a time *on the
+  driver* through a local session (``reducePairBlock``,
+  ``DebugRowOps.scala:741-750``), this engine folds partials on device with
+  one fixed ``[2, ...]``-shaped merge program (and, distributed, replaces
+  the fold with collectives — see ``tensorframes_tpu.parallel``);
+- where the reference's ``TensorFlowUDAF`` buffers rows per group and
+  compacts through TF when full (``DebugRowOps.scala:601-695``), ``aggregate``
+  computes per-row partials with ``vmap`` and combines them with a single
+  *segmented associative scan* on device — one XLA program for any number of
+  groups, instead of a JVM shuffle.
+
+Semantics parity: lazy maps / eager reduces (``Operations.scala:20-135``),
+fetches name the new columns, collisions error, no implicit casting, reduce
+naming conventions ``x_input`` / ``x_1``+``x_2``, trim maps may change the
+row count (``TrimmingOperationsSuite.scala:25-39``).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..capture import CapturedGraph, Node, TensorSpec, build_graph
+from ..capture import dsl as _dsl
+from ..frame import GroupedFrame, TensorFrame
+from ..frame.table import _build_column, _ColumnData
+from ..schema import ColumnInfo, FrameInfo, Shape, Unknown
+from ..utils import ensure_x64, get_logger
+from .validation import (
+    InputNotFoundError,
+    InvalidDimensionError,
+    check_output_collisions,
+    resolve_column,
+    validate_map_inputs,
+    validate_reduce_block_graph,
+    validate_reduce_row_graph,
+)
+
+__all__ = [
+    "map_blocks",
+    "map_rows",
+    "reduce_blocks",
+    "reduce_rows",
+    "aggregate",
+    "analyze",
+    "print_schema",
+    "explain",
+    "block",
+    "row",
+]
+
+logger = get_logger("engine")
+
+# re-export the auto-placeholder helpers at the API level (reference
+# ``core.py:397-450``)
+block = _dsl.block
+row = _dsl.row
+
+
+# ---------------------------------------------------------------------------
+# graph normalization: Node(s) | CapturedGraph | plain callable
+# ---------------------------------------------------------------------------
+
+
+def _as_graph(
+    fetches,
+    df: TensorFrame,
+    *,
+    cell_inputs: bool,
+    feed_dict: Optional[Dict[str, str]] = None,
+) -> CapturedGraph:
+    """Accept the three frontend forms and return a CapturedGraph.
+
+    ``cell_inputs=False``: placeholders for a plain callable get *block*
+    shapes (lead Unknown); ``True``: cell shapes (map_rows / reduce_rows)."""
+    if isinstance(fetches, CapturedGraph):
+        g = fetches
+    elif isinstance(fetches, Node):
+        g = build_graph([fetches])
+    elif isinstance(fetches, (list, tuple)) and fetches and all(
+        isinstance(f, Node) for f in fetches
+    ):
+        g = build_graph(list(fetches))
+    elif callable(fetches):
+        g = _graph_from_callable(fetches, df, cell_inputs, feed_dict)
+    else:
+        raise TypeError(
+            f"fetches must be Node(s), a CapturedGraph, or a callable; got "
+            f"{type(fetches).__name__}"
+        )
+    if feed_dict:
+        g = g.with_inputs(feed_dict)
+    return g
+
+
+def _graph_from_callable(
+    fn: Callable,
+    df: TensorFrame,
+    cell_inputs: bool,
+    feed_dict: Optional[Dict[str, str]],
+) -> CapturedGraph:
+    """Plain-function frontend: parameter names are placeholder names, bound
+    to columns directly or via feed_dict / reduce suffixes."""
+    params = [
+        p.name
+        for p in inspect.signature(fn).parameters.values()
+        if p.kind
+        in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+    ]
+    specs: Dict[str, Tuple] = {}
+    bound: Dict[str, str] = {}
+    missing = []
+    for p in params:
+        col = resolve_column(p, feed_dict or {}, df.schema.names)
+        if col is None:
+            missing.append(p)
+            continue
+        bound[p] = col
+        info = df.schema[col]
+        if cell_inputs:
+            shape = info.cell_shape
+        elif p.endswith("_input"):
+            # block-reduce convention: one dim higher than the cell
+            shape = info.cell_shape.prepend(Unknown)
+        else:
+            shape = info.block_shape.with_lead(Unknown)
+        specs[p] = (info.scalar_type, shape)
+    if missing:
+        raise InputNotFoundError(missing, df.schema.names)
+    probe_feed = None
+    if any(st.name == "binary" for st, _ in specs.values()):
+        # binary programs cannot be abstract-traced; discover outputs by
+        # running on the first row's real cells (host path)
+        if df.num_rows == 0:
+            raise ValueError("cannot capture a binary-input program on an empty frame")
+        probe_feed = {p: df.column_data(c).cell(0) for p, c in bound.items()}
+    return CapturedGraph.from_callable(fn, specs, probe_feed=probe_feed)
+
+
+def _jitted(g: CapturedGraph):
+    j = getattr(g, "_jit_cache", None)
+    if j is None:
+        import jax
+
+        j = jax.jit(g.fn)
+        g._jit_cache = j
+    return j
+
+
+def _jitted_vmap(g: CapturedGraph):
+    j = getattr(g, "_jit_vmap_cache", None)
+    if j is None:
+        import jax
+
+        j = jax.jit(jax.vmap(g.fn))
+        g._jit_vmap_cache = j
+    return j
+
+
+def _ensure_precision(g: CapturedGraph, schema: FrameInfo) -> None:
+    if any(p.scalar_type.is_64bit for p in g.placeholders.values()) or any(
+        c.scalar_type.is_64bit for c in schema
+    ):
+        ensure_x64()
+
+
+def _fetch_column_info(name: str, spec: TensorSpec, block_output: bool) -> ColumnInfo:
+    """Result-column schema for a fetch (reference embeds the output shape in
+    the new column's metadata, ``DebugRowOps.scala:349-360``)."""
+    if block_output:
+        shape = spec.shape
+        nesting = max(spec.shape.num_dims - 1, 0)
+    else:
+        shape = spec.shape.prepend(Unknown)
+        nesting = spec.shape.num_dims
+    return ColumnInfo(
+        name, spec.scalar_type, analyzed_shape=shape, nesting=nesting
+    )
+
+
+def _empty_output(spec: TensorSpec, block_output: bool) -> np.ndarray:
+    cell = spec.shape.tail() if block_output else spec.shape
+    dims = tuple(0 if d == Unknown else d for d in cell.dims)
+    return np.zeros((0,) + dims, dtype=spec.scalar_type.np_dtype)
+
+
+# ---------------------------------------------------------------------------
+# map_blocks
+# ---------------------------------------------------------------------------
+
+
+def map_blocks(
+    fetches,
+    dframe: TensorFrame,
+    trim: bool = False,
+    feed_dict: Optional[Dict[str, str]] = None,
+) -> TensorFrame:
+    """Transform the frame block by block; fetches become new columns
+    (``trim=False``) or the entire output (``trim=True``, row count may
+    change). Lazy, like the reference (``core.py:266-309``).
+
+    Each partition block is one XLA program execution; XLA's jit cache keys
+    on the block shape, so frames with equal-sized partitions compile once.
+    """
+    g = _as_graph(fetches, dframe, cell_inputs=False, feed_dict=feed_dict)
+    binding = validate_map_inputs(g, dframe.schema, block=True)
+    # ragged/binary columns are rejected when blocks are materialized in the
+    # thunk (column_block raises), keeping construction metadata-only/lazy
+    _ensure_precision(g, dframe.schema)
+    input_shapes = {
+        ph: dframe.schema[col].block_shape.with_lead(Unknown)
+        for ph, col in binding.items()
+    }
+    out_specs = g.analyze(input_shapes)
+    for name, spec in out_specs.items():
+        if spec.shape.num_dims == 0:
+            raise InvalidDimensionError(
+                f"map_blocks output {name!r} is a scalar; map outputs must "
+                f"keep the leading row dimension (use reduce_blocks to "
+                f"reduce a frame to one row)"
+            )
+    if not trim:
+        check_output_collisions(out_specs, dframe.schema)
+
+    fetch_names = sorted(out_specs)  # outputs sorted by name (reference)
+    fetch_infos = [
+        _fetch_column_info(n, out_specs[n], block_output=True)
+        for n in fetch_names
+    ]
+    if trim:
+        result_info = FrameInfo(fetch_infos)
+    else:
+        result_info = FrameInfo(fetch_infos + list(dframe.schema))
+
+    jit_fn = _jitted(g)
+    parent = dframe
+
+    def thunk() -> TensorFrame:
+        pieces: Dict[str, List[np.ndarray]] = {n: [] for n in fetch_names}
+        part_sizes: List[int] = []
+        for p in range(parent.num_partitions):
+            lo, hi = parent.partition_bounds()[p]
+            n = hi - lo
+            if n == 0:
+                part_sizes.append(0)
+                continue
+            feed = {
+                ph: parent.column_block(col, p) for ph, col in binding.items()
+            }
+            res = jit_fn(feed)
+            out_n = None
+            for name in fetch_names:
+                arr = np.asarray(res[name])
+                if not trim and arr.shape[0] != n:
+                    raise ValueError(
+                        f"map_blocks output {name!r} produced {arr.shape[0]} "
+                        f"rows for a block of {n}; only trimmed maps may "
+                        f"change the row count"
+                    )
+                out_n = arr.shape[0]
+                pieces[name].append(arr)
+            part_sizes.append(out_n if trim else n)
+        cols: Dict[str, _ColumnData] = {}
+        for name in fetch_names:
+            if pieces[name]:
+                dense = np.concatenate(pieces[name], axis=0)
+            else:
+                dense = _empty_output(out_specs[name], block_output=True)
+            cols[name] = _ColumnData(dense=np.ascontiguousarray(dense))
+        offsets = np.concatenate([[0], np.cumsum(part_sizes)]).astype(np.int64)
+        if trim:
+            return TensorFrame(cols, result_info, offsets=offsets)
+        for c in parent.schema:
+            cols[c.name] = parent.column_data(c.name)
+        return TensorFrame(cols, result_info, offsets=offsets)
+
+    return TensorFrame(
+        {}, result_info, num_partitions=parent.num_partitions, _thunk=thunk
+    )
+
+
+# ---------------------------------------------------------------------------
+# map_rows
+# ---------------------------------------------------------------------------
+
+
+def map_rows(
+    fetches,
+    dframe: TensorFrame,
+    feed_dict: Optional[Dict[str, str]] = None,
+) -> TensorFrame:
+    """Transform row by row (``core.py:223-264``). Rows with equal cell
+    shapes are batched and executed with ``vmap`` in one XLA program per
+    shape bucket — the TPU replacement for the reference's one-Session.run-
+    per-row loop (``performMapRows``, ``DebugRowOps.scala:819-857``). Ragged
+    columns are supported; binary columns run on the host path."""
+    g = _as_graph(fetches, dframe, cell_inputs=True, feed_dict=feed_dict)
+    binding = validate_map_inputs(g, dframe.schema, block=False)
+    _ensure_precision(g, dframe.schema)
+    host_mode = any(
+        dframe.schema[col].scalar_type.name == "binary"
+        for col in binding.values()
+    )
+    if host_mode:
+        # binary programs run on the host; discover output specs from a real
+        # first-row execution (the reference analyzes binary graphs via the
+        # TF runtime — there is no abstract trace for host programs here)
+        if dframe.num_rows == 0:
+            raise ValueError("map_rows on an empty binary-column frame")
+        from ..schema import for_any
+
+        probe = g.fn(
+            {ph: dframe.column_data(col).cell(0) for ph, col in binding.items()}
+        )
+        out_specs = {
+            name: TensorSpec(
+                name,
+                for_any(np.asarray(v) if not isinstance(v, bytes) else v),
+                Shape([Unknown] * np.asarray(v).ndim)
+                if not isinstance(v, bytes)
+                else Shape.empty(),
+            )
+            for name, v in probe.items()
+            if name in g.fetch_names
+        }
+    else:
+        input_shapes = {
+            ph: dframe.schema[col].cell_shape for ph, col in binding.items()
+        }
+        out_specs = g.analyze(input_shapes, share_lead=False)
+    check_output_collisions(out_specs, dframe.schema)
+    fetch_names = sorted(out_specs)
+    fetch_infos = [
+        _fetch_column_info(n, out_specs[n], block_output=False)
+        for n in fetch_names
+    ]
+    result_info = FrameInfo(fetch_infos + list(dframe.schema))
+    parent = dframe
+
+    def thunk() -> TensorFrame:
+        n = parent.num_rows
+        if n == 0:
+            cols = {
+                name: _ColumnData(
+                    dense=_empty_output(out_specs[name], block_output=False)
+                )
+                for name in fetch_names
+            }
+            for c in parent.schema:
+                cols[c.name] = parent.column_data(c.name)
+            return TensorFrame(cols, result_info)
+        col_data = {ph: parent.column_data(col) for ph, col in binding.items()}
+        out_cells: Dict[str, List] = {name: [None] * n for name in fetch_names}
+        if host_mode:
+            for i in range(n):
+                feed = {ph: cd.cell(i) for ph, cd in col_data.items()}
+                res = g.fn(feed)
+                for name in fetch_names:
+                    v = res[name]
+                    out_cells[name][i] = (
+                        v if isinstance(v, (bytes, bytearray)) else np.asarray(v)
+                    )
+        else:
+            # bucket rows by the tuple of input cell shapes
+            buckets: Dict[Tuple, List[int]] = {}
+            for i in range(n):
+                key = tuple(col_data[ph].cell(i).shape for ph in binding)
+                buckets.setdefault(key, []).append(i)
+            vfn = _jitted_vmap(g)
+            for idxs in buckets.values():
+                feed = {
+                    ph: np.stack([col_data[ph].cell(i) for i in idxs])
+                    for ph in binding
+                }
+                res = vfn(feed)
+                for name in fetch_names:
+                    arr = np.asarray(res[name])
+                    for j, i in enumerate(idxs):
+                        out_cells[name][i] = arr[j]
+        cols: Dict[str, _ColumnData] = {}
+        for name in fetch_names:
+            cd, _ = _build_column(name, out_cells[name])
+            cols[name] = cd
+        for c in parent.schema:
+            cols[c.name] = parent.column_data(c.name)
+        offsets = np.array(
+            [lo for lo, _ in parent.partition_bounds()] + [n], dtype=np.int64
+        )
+        return TensorFrame(cols, result_info, offsets=offsets)
+
+    return TensorFrame(
+        {}, result_info, num_partitions=parent.num_partitions, _thunk=thunk
+    )
+
+
+# ---------------------------------------------------------------------------
+# reduce_blocks / reduce_rows
+# ---------------------------------------------------------------------------
+
+
+def _unpack_reduce_result(
+    acc: Dict[str, Any], fetch_names: Sequence[str]
+) -> Union[np.ndarray, List[np.ndarray]]:
+    """Reference ``_unpack_row`` (``core.py:110-124``): numpy per fetch,
+    unwrapped when there is a single fetch."""
+    vals = []
+    for f in fetch_names:
+        a = np.asarray(acc[f])
+        vals.append(a if a.ndim > 0 else a[()])
+    return vals[0] if len(vals) == 1 else vals
+
+
+def reduce_blocks(fetches, dframe: TensorFrame):
+    """Block reduce to a single row (eager; ``core.py:311-349``). One program
+    run per partition block, then a fixed ``[2, ...]`` merge program folds
+    the partials — replacing the reference's executors→driver funnel
+    (``DebugRowOps.scala:503-526``)."""
+    g = _as_graph(fetches, dframe, cell_inputs=False)
+    binding = validate_reduce_block_graph(g, dframe.schema)
+    _ensure_precision(g, dframe.schema)
+    jit_fn = _jitted(g)
+    partials: List[Dict[str, Any]] = []
+    for p in range(dframe.num_partitions):
+        lo, hi = dframe.partition_bounds()[p]
+        if hi - lo == 0:
+            continue
+        feed = {
+            f"{f}_input": dframe.column_block(col, p)
+            for f, col in binding.items()
+        }
+        partials.append(jit_fn(feed))
+    if not partials:
+        raise ValueError("reduce_blocks on an empty frame")
+    import jax.numpy as jnp
+
+    acc = partials[0]
+    for part in partials[1:]:
+        feed = {
+            f"{f}_input": jnp.stack([acc[f], part[f]])
+            for f in binding
+        }
+        acc = jit_fn(feed)
+    return _unpack_reduce_result(acc, g.fetch_names)
+
+
+def reduce_rows(fetches, dframe: TensorFrame):
+    """Pairwise row reduce (eager; ``core.py:184-221``): fetch ``x`` consumes
+    placeholders ``x_1``/``x_2``. Within a partition the fold is a
+    ``lax.scan`` over the block (the reference's sequential
+    ``performReducePairwise``, ``DebugRowOps.scala:930-969``, with the
+    session loop compiled away); across partitions the same merge program
+    folds the partials."""
+    g = _as_graph(fetches, dframe, cell_inputs=True)
+    binding = validate_reduce_row_graph(g, dframe.schema)
+    _ensure_precision(g, dframe.schema)
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    fetch_names = list(g.fetch_names)
+
+    def merge(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+        feed = {}
+        for f in fetch_names:
+            feed[f"{f}_1"] = a[f]
+            feed[f"{f}_2"] = b[f]
+        return g.fn(feed)
+
+    fold_block = getattr(g, "_fold_block_cache", None)
+    if fold_block is None:
+
+        @jax.jit
+        def fold_block(block_feed: Dict[str, Any]) -> Dict[str, Any]:
+            init = {f: block_feed[f][0] for f in fetch_names}
+            rest = {f: block_feed[f][1:] for f in fetch_names}
+
+            def body(carry, xs):
+                return merge(carry, xs), None
+
+            out, _ = lax.scan(body, init, rest)
+            return out
+
+        g._fold_block_cache = fold_block
+
+    merge_jit = getattr(g, "_merge_cache", None)
+    if merge_jit is None:
+        merge_jit = jax.jit(merge)
+        g._merge_cache = merge_jit
+
+    partials: List[Dict[str, Any]] = []
+    for p in range(dframe.num_partitions):
+        lo, hi = dframe.partition_bounds()[p]
+        if hi - lo == 0:
+            continue
+        feed = {
+            f: dframe.column_block(col, p) for f, col in binding.items()
+        }
+        partials.append(fold_block(feed))
+    if not partials:
+        raise ValueError("reduce_rows on an empty frame")
+    acc = partials[0]
+    for part in partials[1:]:
+        acc = merge_jit(acc, part)
+    return _unpack_reduce_result(acc, fetch_names)
+
+
+# ---------------------------------------------------------------------------
+# aggregate
+# ---------------------------------------------------------------------------
+
+
+def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
+    """Keyed algebraic aggregation (``core.py:377-395``): for grouped data,
+    reduce each group with the block-reduce graph.
+
+    TPU-native design replacing the reference's Spark-shuffle UDAF
+    (``TensorFlowUDAF``, ``DebugRowOps.scala:601-695``):
+
+    1. per-row partials: the reduce graph runs on blocks of 1 via ``vmap``
+       (one program, any row count);
+    2. rows sorted by group key on the host (cheap integer argsort);
+    3. one *segmented associative scan* on device combines partials within
+       segments — ``combine((a,fa),(b,fb)) = (fb ? b : merge(a,b), fa|fb)``
+       where ``merge`` stacks two partials and re-applies the reduce graph;
+    4. the last scan element of each segment is that group's result.
+
+    The merge is assumed associative, same as the reference ("algebraic
+    aggregation", ``Operations.scala:110-120``).
+    """
+    dframe = grouped_data.frame
+    keys = grouped_data.keys
+    if not keys:
+        raise ValueError("aggregate requires at least one grouping column")
+    g = _as_graph(fetches, dframe, cell_inputs=False)
+    binding = validate_reduce_block_graph(g, dframe.schema)
+    for k in keys:
+        kd = dframe.column_data(k)
+        if kd.dense is None or kd.dense.ndim != 1:
+            raise ValueError(f"grouping column {k!r} must be dense scalars")
+        if k in binding.values():
+            raise ValueError(f"column {k!r} cannot be both key and input")
+    _ensure_precision(g, dframe.schema)
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    fetch_names = list(g.fetch_names)
+    n = dframe.num_rows
+    if n == 0:
+        raise ValueError("aggregate on an empty frame")
+
+    # -- host: group codes + stable sort by key
+    key_cols = [np.asarray(dframe.column_block(k)) for k in keys]
+    # group identity over multiple key columns via a structured view
+    stacked = np.rec.fromarrays(key_cols)
+    _, codes = np.unique(stacked, return_inverse=True)
+    order = np.argsort(codes, kind="stable")
+    codes_sorted = codes[order]
+    flags = np.empty(n, dtype=bool)
+    flags[0] = True
+    flags[1:] = codes_sorted[1:] != codes_sorted[:-1]
+
+    scan_fn = getattr(g, "_agg_scan_cache", None)
+    if scan_fn is None:
+
+        def merge_pair(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+            feed = {
+                f"{f}_input": jnp.stack([a[f], b[f]]) for f in fetch_names
+            }
+            return g.fn(feed)
+
+        vmerge = jax.vmap(merge_pair)
+
+        @jax.jit
+        def scan_fn(block_feed: Dict[str, Any], flags_: Any) -> Dict[str, Any]:
+            # per-row partials: reduce graph applied to blocks of one row
+            per_row = jax.vmap(
+                lambda cells: g.fn(
+                    {
+                        f"{f}_input": cells[f][None] for f in fetch_names
+                    }
+                )
+            )({f: block_feed[f] for f in fetch_names})
+
+            def combine(x, y):
+                vx, fx = x
+                vy, fy = y
+                merged = vmerge(vx, vy)
+                out = {}
+                for f in fetch_names:
+                    fy_b = fy.reshape(fy.shape + (1,) * (merged[f].ndim - 1))
+                    out[f] = jnp.where(fy_b, vy[f], merged[f])
+                return out, fx | fy
+
+            scanned, _ = lax.associative_scan(combine, (per_row, flags_), axis=0)
+            return scanned
+
+        g._agg_scan_cache = scan_fn
+
+    sorted_feed = {
+        f: np.ascontiguousarray(np.asarray(dframe.column_block(col))[order])
+        for f, col in binding.items()
+    }
+    scanned = scan_fn(sorted_feed, flags)
+    # last row of each segment holds that group's reduce
+    ends = np.append(np.nonzero(flags[1:])[0], n - 1)
+
+    out_specs = g.analyze(
+        {
+            f"{f}_input": dframe.schema[col].block_shape.with_lead(Unknown)
+            for f, col in binding.items()
+        }
+    )
+    cols: Dict[str, _ColumnData] = {}
+    infos: List[ColumnInfo] = []
+    for k, kc in zip(keys, key_cols):
+        cols[k] = _ColumnData(dense=np.ascontiguousarray(kc[order][ends]))
+        infos.append(dframe.schema[k])
+    for f in fetch_names:
+        arr = np.asarray(scanned[f])[ends]
+        cols[f] = _ColumnData(dense=np.ascontiguousarray(arr))
+        infos.append(_fetch_column_info(f, out_specs[f], block_output=False))
+    return TensorFrame(cols, FrameInfo(infos))
+
+
+# ---------------------------------------------------------------------------
+# analyze / print_schema / explain
+# ---------------------------------------------------------------------------
+
+
+def analyze(dframe: TensorFrame) -> TensorFrame:
+    """Deep shape analysis (``core.py:362-375``); see
+    :meth:`TensorFrame.analyze`."""
+    return dframe.analyze()
+
+
+def explain(dframe: TensorFrame) -> str:
+    """Detailed schema string (reference ``DebugRowOps.explain``,
+    ``DebugRowOps.scala:528-545``)."""
+    return dframe.schema.explain()
+
+
+def print_schema(dframe: TensorFrame) -> None:
+    """Print the tensor schema (``core.py:351-360``)."""
+    print(explain(dframe))

@@ -1,0 +1,239 @@
+"""Pre-flight validation: the schema contract every op enforces.
+
+Analog of the reference's ``SchemaTransforms``
+(``/root/reference/src/main/scala/org/tensorframes/impl/DebugRowOps.scala:53-275``)
+and its error types (``Operations.scala:7-15``):
+
+- every graph input must be fed by a frame column or a constant
+  (``InputNotFoundException``);
+- **no implicit casting** — placeholder dtype must equal column dtype
+  (``core.py:236-237``);
+- placeholder shapes must be compatible with the column's (analyzed) shape,
+  with ``Unknown`` acting as a wildcard (``Shape.checkMorePreciseThan``,
+  ``Shape.scala:54-59``);
+- map outputs must not collide with existing column names
+  (``Operations.scala:30-31``);
+- reduce naming conventions: fetch ``x`` pairs with placeholder ``x_input``
+  (block reduce, one dim higher) or ``x_1``/``x_2`` (row reduce, same shape)
+  (``Operations.scala:83-108``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..capture.graph import CapturedGraph, TensorSpec
+from ..schema import ColumnInfo, FrameInfo, Shape, Unknown
+
+__all__ = [
+    "InputNotFoundError",
+    "InvalidTypeError",
+    "InvalidDimensionError",
+    "OutputCollisionError",
+    "resolve_column",
+    "validate_map_inputs",
+    "validate_reduce_block_graph",
+    "validate_reduce_row_graph",
+    "check_output_collisions",
+]
+
+#: suffixes that bind a placeholder to a column by convention
+#: (reference ``Operations.scala:86-107``).
+REDUCE_SUFFIXES = ("_input", "_1", "_2")
+
+
+class InputNotFoundError(KeyError):
+    """Analog of ``InputNotFoundException`` (``Operations.scala:7-8``)."""
+
+    def __init__(self, inputs: Sequence[str], available: Sequence[str]):
+        self.inputs = list(inputs)
+        msg = (
+            f"The following inputs were not provided: {', '.join(inputs)} "
+            f"(available columns: {', '.join(available)})"
+        )
+        super().__init__(msg)
+        self.msg = msg
+
+    def __str__(self):
+        return self.msg
+
+
+class InvalidTypeError(TypeError):
+    """No implicit casting (``Operations.scala:14-15``)."""
+
+
+class InvalidDimensionError(ValueError):
+    """Shape incompatibility (``Operations.scala:10-12``)."""
+
+
+class OutputCollisionError(ValueError):
+    """Fetch name equals an existing column (``Operations.scala:30-31``)."""
+
+
+def resolve_column(
+    ph_name: str,
+    inputs_map: Dict[str, str],
+    columns: Sequence[str],
+    allow_suffix: bool = True,
+) -> Optional[str]:
+    """Find the frame column feeding a placeholder: explicit map first, then
+    the placeholder's own name, then reduce-convention suffix stripping."""
+    col = inputs_map.get(ph_name, ph_name)
+    if col in columns:
+        return col
+    if allow_suffix:
+        for suf in REDUCE_SUFFIXES:
+            if col.endswith(suf) and col[: -len(suf)] in columns:
+                return col[: -len(suf)]
+    return None
+
+
+def _compatible(declared: Shape, actual: Shape) -> bool:
+    """Shapes agree wherever both are known (Unknown = wildcard)."""
+    if declared.num_dims != actual.num_dims:
+        return False
+    return all(
+        a == Unknown or b == Unknown or a == b
+        for a, b in zip(declared.dims, actual.dims)
+    )
+
+
+def validate_map_inputs(
+    graph: CapturedGraph,
+    schema: FrameInfo,
+    block: bool,
+) -> Dict[str, str]:
+    """Check every placeholder maps to a column with matching dtype and a
+    compatible shape; returns placeholder name -> column name.
+
+    ``block=True``: placeholder shape is a block shape (one dim higher than
+    the cell, ``Operations.scala:52-53``); ``block=False``: cell shape."""
+    binding: Dict[str, str] = {}
+    missing: List[str] = []
+    for ph in graph.placeholders.values():
+        col = resolve_column(ph.name, graph.inputs_map, schema.names)
+        if col is None:
+            missing.append(ph.name)
+            continue
+        binding[ph.name] = col
+    if missing:
+        raise InputNotFoundError(missing, schema.names)
+    for ph_name, col_name in binding.items():
+        ph = graph.placeholders[ph_name]
+        info = schema[col_name]
+        if ph.scalar_type.name != info.scalar_type.name:
+            raise InvalidTypeError(
+                f"Input {col_name!r} is of type {info.scalar_type.name}, but "
+                f"the graph expected an input of type {ph.scalar_type.name} "
+                f"for placeholder {ph_name!r} (no implicit casting is "
+                f"performed)"
+            )
+        expected = info.block_shape if block else info.cell_shape
+        if not _compatible(ph.shape, expected):
+            kind = "block" if block else "cell"
+            raise InvalidDimensionError(
+                f"Placeholder {ph_name!r} declares shape {ph.shape}, which is "
+                f"incompatible with column {col_name!r}'s {kind} shape "
+                f"{expected}"
+            )
+    return binding
+
+
+def check_output_collisions(
+    out_specs: Dict[str, TensorSpec], schema: FrameInfo
+) -> None:
+    for name in out_specs:
+        if name in schema:
+            raise OutputCollisionError(
+                f"Output {name!r} has the same name as an existing column; "
+                f"map outputs must be all different from the names of "
+                f"existing columns"
+            )
+
+
+def validate_reduce_block_graph(
+    graph: CapturedGraph, schema: FrameInfo
+) -> Dict[str, str]:
+    """For each fetch ``x``: require placeholder ``x_input`` whose dtype
+    equals the column's, with shape one dim higher than the cell
+    (reference ``reduceBlocksSchema``, ``DebugRowOps.scala:80-170``).
+    Returns fetch name -> column name."""
+    binding: Dict[str, str] = {}
+    missing: List[str] = []
+    for fetch in graph.fetch_names:
+        ph_name = f"{fetch}_input"
+        if ph_name not in graph.placeholders:
+            raise InvalidDimensionError(
+                f"Reduce fetch {fetch!r} requires a placeholder named "
+                f"{ph_name!r} (block-reduce naming convention); placeholders: "
+                f"{sorted(graph.placeholders)}"
+            )
+        col = resolve_column(ph_name, graph.inputs_map, schema.names)
+        if col is None:
+            missing.append(ph_name)
+            continue
+        binding[fetch] = col
+    if missing:
+        raise InputNotFoundError(missing, schema.names)
+    for fetch, col in binding.items():
+        ph = graph.placeholders[f"{fetch}_input"]
+        info = schema[col]
+        if ph.scalar_type.name != info.scalar_type.name:
+            raise InvalidTypeError(
+                f"Column {col!r} is {info.scalar_type.name} but placeholder "
+                f"{fetch}_input expects {ph.scalar_type.name}"
+            )
+        if ph.shape.num_dims != info.cell_shape.num_dims + 1:
+            raise InvalidDimensionError(
+                f"Block-reduce placeholder {fetch}_input must be one "
+                f"dimension higher than column {col!r}: placeholder "
+                f"{ph.shape} vs cell {info.cell_shape}"
+            )
+        if not _compatible(ph.shape.tail(), info.cell_shape):
+            raise InvalidDimensionError(
+                f"Block-reduce placeholder {fetch}_input shape {ph.shape} is "
+                f"incompatible with column {col!r} cell shape {info.cell_shape}"
+            )
+    return binding
+
+
+def validate_reduce_row_graph(
+    graph: CapturedGraph, schema: FrameInfo
+) -> Dict[str, str]:
+    """For each fetch ``x``: require placeholders ``x_1`` and ``x_2`` with the
+    column's dtype and cell shape (reference ``reduceRowsSchema``,
+    ``DebugRowOps.scala:172-275``). Returns fetch name -> column name."""
+    binding: Dict[str, str] = {}
+    missing: List[str] = []
+    for fetch in graph.fetch_names:
+        for suffix in ("_1", "_2"):
+            ph_name = f"{fetch}{suffix}"
+            if ph_name not in graph.placeholders:
+                raise InvalidDimensionError(
+                    f"Row-reduce fetch {fetch!r} requires placeholders "
+                    f"{fetch}_1 and {fetch}_2; placeholders: "
+                    f"{sorted(graph.placeholders)}"
+                )
+        col = resolve_column(f"{fetch}_1", graph.inputs_map, schema.names)
+        if col is None:
+            missing.append(f"{fetch}_1")
+            continue
+        binding[fetch] = col
+    if missing:
+        raise InputNotFoundError(missing, schema.names)
+    for fetch, col in binding.items():
+        info = schema[col]
+        for suffix in ("_1", "_2"):
+            ph = graph.placeholders[f"{fetch}{suffix}"]
+            if ph.scalar_type.name != info.scalar_type.name:
+                raise InvalidTypeError(
+                    f"Column {col!r} is {info.scalar_type.name} but "
+                    f"placeholder {fetch}{suffix} expects {ph.scalar_type.name}"
+                )
+            if not _compatible(ph.shape, info.cell_shape):
+                raise InvalidDimensionError(
+                    f"Row-reduce placeholder {fetch}{suffix} shape {ph.shape} "
+                    f"is incompatible with column {col!r} cell shape "
+                    f"{info.cell_shape}"
+                )
+    return binding

@@ -179,7 +179,9 @@ def for_any(x) -> ScalarType:
         return INT64
     if isinstance(x, float):
         return FLOAT64
-    if hasattr(x, "dtype"):
+    # guard against dtype *classes* (np.float64 etc.), whose `dtype` attr is
+    # a descriptor, not a dtype — for_numpy_dtype handles them directly
+    if hasattr(x, "dtype") and not isinstance(x, type):
         return for_numpy_dtype(x.dtype)
     return for_numpy_dtype(x)
 

@@ -1,0 +1,6 @@
+"""Utilities: logging, config, profiling hooks."""
+
+from .config import ensure_x64
+from .logging import get_logger
+
+__all__ = ["ensure_x64", "get_logger"]

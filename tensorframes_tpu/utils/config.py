@@ -1,0 +1,34 @@
+"""Precision / device configuration helpers.
+
+The reference has no config system at all (SURVEY §5: per-op configuration is
+the ``ShapeDescription`` hint object; the UDAF buffer size is a hard-coded
+``10``, ``DebugRowOps.scala:573``). Engine knobs will be added here as they
+gain consumers; today the only global switch is 64-bit precision.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["ensure_x64"]
+
+_lock = threading.Lock()
+_x64_done = False
+
+
+def ensure_x64() -> None:
+    """Enable jax 64-bit types on demand.
+
+    The reference's parity dtype set includes float64/int64
+    (``datatypes.scala:265-267``) and its README examples round-trip doubles;
+    JAX disables x64 by default, so the engine flips it lazily the first time
+    a 64-bit column reaches a device computation."""
+    global _x64_done
+    if _x64_done:
+        return
+    with _lock:
+        if not _x64_done:
+            import jax
+
+            jax.config.update("jax_enable_x64", True)
+            _x64_done = True

@@ -1,0 +1,10 @@
+"""Logging shim (analog of the reference's ``Logging`` trait over slf4j,
+``/root/reference/src/main/scala/org/tensorframes/Logging.scala:5-9``)."""
+
+import logging
+
+_ROOT = "tensorframes_tpu"
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)

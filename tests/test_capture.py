@@ -1,0 +1,228 @@
+"""Capture-layer tests: DSL naming/wiring (analog of the reference's DSL
+suites + ExtractNodes oracle tests), analysis (analog of
+TFInitializationSuite's analyzeGraphTF round-trips), serialization."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu.capture as cap
+from tensorframes_tpu.capture import functions as F
+from tensorframes_tpu.frame import TensorFrame
+from tensorframes_tpu.schema import FLOAT64, INT32, Shape, Unknown
+
+
+def make_df():
+    return TensorFrame.from_columns({"x": np.arange(10.0)})
+
+
+def make_vec_df():
+    return TensorFrame.from_columns(
+        {"y": [[float(i), float(-i)] for i in range(10)]}
+    ).analyze()
+
+
+class TestNaming:
+    def test_auto_numbering(self):
+        with cap.graph():
+            a = cap.constant(1.0)
+            b = cap.constant(2.0)
+            c = a + b
+            d = a + b
+            assert a.name == "constant"
+            assert b.name == "constant_1"
+            assert c.name == "add"
+            assert d.name == "add_1"
+
+    def test_named(self):
+        with cap.graph():
+            z = (cap.constant(1.0) + 3).named("z")
+            assert z.name == "z"
+
+    def test_scope(self):
+        with cap.graph():
+            with cap.scope("outer"):
+                a = cap.constant(1.0)
+                z = F.identity(a, name="z")
+            assert a.name == "outer/constant"
+            assert z.name == "outer/z"
+
+    def test_graph_isolation(self):
+        with cap.graph():
+            a = cap.constant(1.0)
+        with cap.graph():
+            b = cap.constant(1.0)
+        assert a.name == b.name == "constant"
+
+
+class TestCapture:
+    def test_block_placeholder_shape(self):
+        df = make_vec_df()
+        with cap.graph():
+            y = cap.block(df, "y")
+            assert y.ph_spec.shape == Shape(Unknown, 2)
+            r = cap.row(df, "y")
+            assert r.ph_spec.shape == Shape(2)
+
+    def test_capture_simple(self):
+        df = make_df()
+        with cap.graph():
+            x = cap.block(df, "x")
+            z = (x + 3.0).named("z")
+            g = cap.build_graph(z)
+        assert list(g.placeholders) == ["x"]
+        assert g.fetch_names == ["z"]
+        assert g.inputs_map == {"x": "x"}
+
+    def test_renamed_placeholder_keeps_binding(self):
+        # reference README.md:116-117: tfs.block(df3, 'y', tf_name='y_input')
+        df = make_vec_df()
+        with cap.graph():
+            y_in = cap.block(df, "y", tft_name="y_input")
+            s = F.reduce_sum(y_in, axis=[0], name="y")
+            g = cap.build_graph(s)
+        assert g.inputs_map == {"y_input": "y"}
+
+    def test_duplicate_fetches_rejected(self):
+        # reference core.py:105-107
+        df = make_df()
+        with cap.graph():
+            x = cap.block(df, "x")
+            a = (x + 1).named("z")
+            b = (x + 2).named("z")
+            with pytest.raises(ValueError, match="unique names"):
+                cap.build_graph([a, b])
+
+    def test_fn_evaluates(self):
+        df = make_df()
+        with cap.graph():
+            x = cap.block(df, "x")
+            z = (x * 2.0 + 1.0).named("z")
+            g = cap.build_graph(z)
+        out = g.fn({"x": np.arange(4.0)})
+        np.testing.assert_allclose(np.asarray(out["z"]), [1, 3, 5, 7])
+
+    def test_constant_only_graph(self):
+        with cap.graph():
+            c = (cap.constant(np.array([1.0, 2.0])) * 2).named("c")
+            g = cap.build_graph(c)
+        assert list(g.placeholders) == []
+        np.testing.assert_allclose(np.asarray(g.fn({})["c"]), [2.0, 4.0])
+
+
+class TestAnalysis:
+    def test_analyze_block_add(self):
+        df = make_df()
+        with cap.graph():
+            x = cap.block(df, "x")
+            z = (x + 3.0).named("z")
+            g = cap.build_graph(z)
+        out = g.analyze()
+        assert out["z"].scalar_type is FLOAT64
+        assert out["z"].shape == Shape(Unknown)
+
+    def test_analyze_reduce_shape(self):
+        df = make_vec_df()
+        with cap.graph():
+            y_in = cap.block(df, "y", tft_name="y_input")
+            s = F.reduce_sum(y_in, axis=[0], name="y")
+            g = cap.build_graph(s)
+        out = g.analyze(input_shapes={"y_input": Shape(Unknown, 2)})
+        assert out["y"].shape == Shape(2)
+
+    def test_analyze_preserves_symbolic_lead(self):
+        df = make_vec_df()
+        with cap.graph():
+            y = cap.block(df, "y")
+            z = F.reduce_sum(y, axis=[1], name="z")
+            g = cap.build_graph(z)
+        out = g.analyze()
+        # lead dim rides through the op: stays Unknown (symbolic)
+        assert out["z"].shape == Shape(Unknown)
+
+    def test_analyze_int_dtype(self):
+        df = TensorFrame.from_columns({"k": np.arange(5, dtype=np.int32)})
+        with cap.graph():
+            k = cap.block(df, "k")
+            z = (k * 2).named("z")
+            g = cap.build_graph(z)
+        out = g.analyze()
+        assert out["z"].scalar_type is INT32
+
+    def test_shape_hint_overrides(self):
+        df = make_df()
+        with cap.graph():
+            x = cap.block(df, "x")
+            z = F.identity(x, name="z")
+            g = cap.build_graph(z).with_hints({"z": Shape(10)})
+        out = g.analyze()
+        assert out["z"].shape == Shape(10)
+
+    def test_missing_fetch_detected(self):
+        g = cap.CapturedGraph.from_callable(
+            lambda x: {"a": x},
+            {"x": (FLOAT64, Shape(Unknown))},
+            fetch_names=["zz"],
+        )
+        with pytest.raises(KeyError, match="zz"):
+            g.analyze()
+
+    def test_node_summaries(self):
+        df = make_df()
+        with cap.graph():
+            x = cap.block(df, "x")
+            z = (x + 1.0).named("z")
+            g = cap.build_graph(z)
+        summaries = g.node_summaries()
+        by_name = {s.name: s for s in summaries}
+        assert by_name["x"].is_input and not by_name["x"].is_output
+        assert by_name["z"].is_output
+
+
+class TestCallableFrontend:
+    def test_from_callable_infers_fetches(self):
+        g = cap.CapturedGraph.from_callable(
+            lambda x: {"z": x + 3.0, "w": x * 2.0},
+            {"x": (FLOAT64, Shape(Unknown))},
+        )
+        assert set(g.fetch_names) == {"z", "w"}
+        out = g.analyze()
+        assert out["z"].shape == Shape(Unknown)
+
+    def test_single_fetch_array_return(self):
+        g = cap.CapturedGraph.from_callable(
+            lambda x: x + 1.0,
+            {"x": (FLOAT64, Shape(Unknown))},
+            fetch_names=["z"],
+        )
+        out = g.fn({"x": np.arange(3.0)})
+        np.testing.assert_allclose(np.asarray(out["z"]), [1, 2, 3])
+
+    def test_feed_dict_merge(self):
+        g = cap.CapturedGraph.from_callable(
+            lambda inp: {"z": inp * 2},
+            {"inp": (FLOAT64, Shape(Unknown))},
+        ).with_inputs({"inp": "some_col"})
+        assert g.inputs_map == {"inp": "some_col"}
+        with pytest.raises(KeyError, match="unknown placeholder"):
+            g.with_inputs({"nope": "c"})
+
+
+class TestSerialize:
+    def test_roundtrip(self, tmp_path):
+        df = make_vec_df()
+        with cap.graph():
+            y = cap.block(df, "y")
+            z = F.reduce_sum(y, axis=[1], name="z")
+            g = cap.build_graph(z)
+        path = str(tmp_path / "g.tfs")
+        cap.save_graph(g, path)
+        g2 = cap.load_graph(path)
+        assert g2.fetch_names == ["z"]
+        assert list(g2.placeholders) == ["y"]
+        data = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        out = g2.fn({"y": data})
+        np.testing.assert_allclose(np.asarray(out["z"]), [3.0, 7.0, 11.0])
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError, match="serialized graph"):
+            cap.deserialize_graph(b"garbage")

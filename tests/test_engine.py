@@ -1,0 +1,325 @@
+"""Engine tests: the reference's BasicOperationsSuite + core_test.py
+equivalents (`/root/reference/src/test/scala/org/tensorframes/BasicOperationsSuite.scala`,
+`src/main/python/tensorframes/tests/core_test.py`), including both README
+examples end-to-end (README.md:60-128)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.capture import functions as F
+
+
+def scalar_df(n=10, dtype=np.float64, parts=1):
+    return tft.TensorFrame.from_columns(
+        {"x": np.arange(n, dtype=dtype)}, num_partitions=parts
+    )
+
+
+class TestReadmeExamples:
+    def test_readme_add3(self):
+        # README.md:60-90: add 3 to column x, z appears next to x
+        df = tft.TensorFrame.from_rows([dict(x=float(x)) for x in range(10)])
+        with tft.graph():
+            x = tft.block(df, "x")
+            z = (x + 3).named("z")
+            df2 = tft.map_blocks(z, df)
+        assert df2.is_lazy  # lazy until collected
+        rows = df2.collect()
+        assert rows[0] == {"z": 3.0, "x": 0.0}
+        assert [r.z for r in rows] == [float(z + 3) for z in range(10)]
+        assert [r.x for r in rows] == [float(x) for x in range(10)]
+
+    def test_readme_vector_reduce(self):
+        # README.md:93-128: analyze, select+alias, reduce_sum + reduce_min
+        df = tft.TensorFrame.from_rows(
+            [dict(y=[float(y), float(-y)]) for y in range(10)]
+        )
+        df2 = tft.analyze(df)
+        assert "DoubleType[10,2]" in tft.explain(df2)
+        df3 = df2.select("y", ("y", "z"))
+        with tft.graph():
+            y_input = tft.block(df3, "y", tft_name="y_input")
+            z_input = tft.block(df3, "z", tft_name="z_input")
+            y = F.reduce_sum(y_input, axis=[0], name="y")
+            z = F.reduce_min(z_input, axis=[0], name="z")
+            data_sum, data_min = tft.reduce_blocks([y, z], df3)
+        np.testing.assert_allclose(data_sum, [45.0, -45.0])
+        np.testing.assert_allclose(data_min, [0.0, -9.0])
+
+    def test_readme_vector_reduce_multipartition(self):
+        df = tft.TensorFrame.from_rows(
+            [dict(y=[float(y), float(-y)]) for y in range(10)],
+            num_partitions=3,
+        )
+        df2 = tft.analyze(df)
+        with tft.graph():
+            y_input = tft.block(df2, "y", tft_name="y_input")
+            y = F.reduce_sum(y_input, axis=[0], name="y")
+            out = tft.reduce_blocks(y, df2)
+        np.testing.assert_allclose(out, [45.0, -45.0])
+
+
+class TestMapBlocks:
+    def test_identity(self):
+        df = scalar_df()
+        with tft.graph():
+            x = tft.block(df, "x")
+            out = tft.map_blocks(F.identity(x, name="z"), df).collect()
+        assert [r.z for r in out] == [r.x for r in out]
+
+    def test_multi_partition(self):
+        df = scalar_df(10, parts=3)
+        with tft.graph():
+            x = tft.block(df, "x")
+            df2 = tft.map_blocks((x * 2.0).named("z"), df)
+        assert [r.z for r in df2.collect()] == [2.0 * i for i in range(10)]
+        assert df2.num_partitions == 3
+
+    def test_callable_frontend(self):
+        df = scalar_df(5)
+        df2 = tft.map_blocks(lambda x: {"z": x + 1.0, "w": x * x}, df)
+        rows = df2.collect()
+        assert rows[2].z == 3.0 and rows[2].w == 4.0
+
+    def test_trim_changes_row_count(self):
+        # reference TrimmingOperationsSuite.scala:25-39
+        df = scalar_df(6)
+        df2 = tft.map_blocks(
+            lambda x: {"z": x[:2]}, df, trim=True
+        )
+        rows = df2.collect()
+        assert len(rows) == 2
+        assert list(rows[0].keys()) == ["z"]
+
+    def test_nontrim_rowcount_change_rejected(self):
+        df = scalar_df(6)
+        df2 = tft.map_blocks(lambda x: {"z": x[:2]}, df)
+        with pytest.raises(ValueError, match="row count"):
+            df2.collect()
+
+    def test_output_collision(self):
+        df = scalar_df()
+        with pytest.raises(tft.OutputCollisionError):
+            tft.map_blocks(lambda x: {"x": x}, df)
+
+    def test_missing_input(self):
+        df = scalar_df()
+        with pytest.raises(tft.InputNotFoundError, match="not provided"):
+            tft.map_blocks(lambda nope: {"z": nope}, df)
+
+    def test_no_implicit_casting(self):
+        df = scalar_df(dtype=np.float32)
+        with tft.graph():
+            ph = tft.placeholder("float64", [-1], name="x")
+            with pytest.raises(tft.InvalidTypeError, match="float64"):
+                tft.map_blocks(tft.build_graph((ph + 1).named("z")), df)
+
+    def test_shape_mismatch(self):
+        df = tft.TensorFrame.from_columns({"y": [[1.0, 2.0], [3.0, 4.0]]}).analyze()
+        with tft.graph():
+            ph = tft.placeholder("float64", [-1, 3], name="y")
+            with pytest.raises(tft.InvalidDimensionError, match="incompatible"):
+                tft.map_blocks(tft.build_graph((ph + 1).named("z")), df)
+
+    def test_vector_output(self):
+        df = scalar_df(4)
+        df2 = tft.map_blocks(lambda x: {"z": np.ones((1, 2)) * x[:, None]}, df)
+        rows = df2.collect()
+        assert rows[3].z.tolist() == [3.0, 3.0]
+
+    def test_int_types(self):
+        for dt, st in [(np.int32, "int32"), (np.int64, "int64")]:
+            df = scalar_df(5, dtype=dt)
+            df2 = tft.map_blocks(lambda x: {"z": x * 2}, df)
+            assert df2.schema["z"].scalar_type.name == st
+            assert [r.z for r in df2.collect()] == [0, 2, 4, 6, 8]
+
+    def test_feed_dict(self):
+        df = tft.TensorFrame.from_columns({"col": np.arange(4.0)})
+        df2 = tft.map_blocks(
+            lambda inp: {"z": inp + 1.0}, df, feed_dict={"inp": "col"}
+        )
+        assert [r.z for r in df2.collect()] == [1.0, 2.0, 3.0, 4.0]
+
+    def test_lazy_chaining(self):
+        df = scalar_df(4)
+        df2 = tft.map_blocks(lambda x: {"z": x + 1.0}, df)
+        df3 = tft.map_blocks(lambda z: {"w": z * 10.0}, df2)
+        assert df3.is_lazy
+        rows = df3.collect()
+        assert rows[1].w == 20.0 and rows[1].z == 2.0 and rows[1].x == 1.0
+
+
+class TestMapRows:
+    def test_simple(self):
+        df = scalar_df(5)
+        with tft.graph():
+            x = tft.row(df, "x")
+            df2 = tft.map_rows((x * 2.0).named("z"), df)
+        assert [r.z for r in df2.collect()] == [0.0, 2.0, 4.0, 6.0, 8.0]
+
+    def test_ragged(self):
+        df = tft.TensorFrame.from_columns(
+            {"y": [[1.0], [2.0, 3.0], [4.0]]}
+        ).analyze()
+        df2 = tft.map_rows(lambda y: {"s": y.sum()}, df)
+        assert [r.s for r in df2.collect()] == [1.0, 5.0, 4.0]
+
+    def test_ragged_vector_output(self):
+        df = tft.TensorFrame.from_columns({"y": [[1.0], [2.0, 3.0]]}).analyze()
+        df2 = tft.map_rows(lambda y: {"d": y * 2}, df)
+        cells = [r.d for r in df2.collect()]
+        assert cells[0].tolist() == [2.0]
+        assert cells[1].tolist() == [4.0, 6.0]
+
+    def test_feed_dict(self):
+        # reference core_test.py:107-118
+        df = scalar_df(3)
+        df2 = tft.map_rows(
+            lambda inp: {"z": inp + 1.0}, df, feed_dict={"inp": "x"}
+        )
+        assert [r.z for r in df2.collect()] == [1.0, 2.0, 3.0]
+
+    def test_binary_host_path(self):
+        df = tft.TensorFrame.from_columns({"b": [b"ab", b"abc", b""]})
+        df2 = tft.map_rows(
+            lambda b: {"length": np.int64(len(b))}, df
+        )
+        assert [r.length for r in df2.collect()] == [2, 3, 0]
+
+
+class TestReduce:
+    def test_reduce_blocks_scalar(self):
+        df = scalar_df(10, parts=2)
+        out = tft.reduce_blocks(lambda x_input: {"x": x_input.sum()}, df)
+        assert float(out) == 45.0
+
+    def test_reduce_blocks_missing_convention(self):
+        df = scalar_df()
+        with pytest.raises(tft.InvalidDimensionError, match="x_input"):
+            tft.reduce_blocks(lambda x: {"x": x.sum()}, df)
+
+    def test_reduce_rows(self):
+        # reference: fetch x needs placeholders x_1, x_2
+        df = scalar_df(10, parts=3)
+        out = tft.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, df)
+        assert float(out) == 45.0
+
+    def test_reduce_rows_vector(self):
+        df = tft.TensorFrame.from_columns(
+            {"y": [[float(i), 1.0] for i in range(5)]}, num_partitions=2
+        ).analyze()
+        out = tft.reduce_rows(lambda y_1, y_2: {"y": y_1 + y_2}, df)
+        np.testing.assert_allclose(out, [10.0, 5.0])
+
+    def test_reduce_rows_single_row(self):
+        df = scalar_df(1)
+        out = tft.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, df)
+        assert float(out) == 0.0
+
+    def test_reduce_empty_frame(self):
+        df = scalar_df(3).filter_rows(np.array([False] * 3))
+        with pytest.raises(ValueError, match="empty"):
+            tft.reduce_blocks(lambda x_input: {"x": x_input.sum()}, df)
+
+    def test_reduce_multiple_fetches_order(self):
+        # each fetch needs its own <fetch>_input; duplicate the column via
+        # select+alias as the README does (README.md:112-121)
+        df = scalar_df(4).select("x", ("x", "m"))
+        m, x = tft.reduce_blocks(
+            lambda x_input, m_input: {"x": x_input.sum(), "m": m_input.max()},
+            df,
+        )
+        # callable-frontend fetches come back in sorted-name order
+        assert (float(m), float(x)) == (3.0, 6.0)
+
+
+class TestAggregate:
+    def test_sum_by_key(self):
+        # reference core_test.py:213-222
+        df = tft.TensorFrame.from_columns(
+            {
+                "key": np.array([1, 1, 2, 2, 2], dtype=np.int64),
+                "x": np.array([1.0, 2.0, 10.0, 20.0, 30.0]),
+            }
+        )
+        out = tft.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)},
+            df.group_by("key"),
+        )
+        rows = sorted(out.collect(), key=lambda r: r.key)
+        assert [(r.key, r.x) for r in rows] == [(1, 3.0), (2, 60.0)]
+
+    def test_min_by_key_unsorted_input(self):
+        df = tft.TensorFrame.from_columns(
+            {
+                "k": np.array([3, 1, 3, 1, 2], dtype=np.int32),
+                "v": np.array([5.0, 7.0, 2.0, 1.0, 9.0]),
+            }
+        )
+        out = tft.aggregate(
+            lambda v_input: {"v": v_input.min(axis=0)}, df.group_by("k")
+        )
+        rows = sorted(out.collect(), key=lambda r: r.k)
+        assert [(r.k, r.v) for r in rows] == [(1, 1.0), (2, 9.0), (3, 2.0)]
+
+    def test_vector_aggregate(self):
+        df = tft.TensorFrame.from_columns(
+            {
+                "k": np.array([0, 0, 1], dtype=np.int64),
+                "y": [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]],
+            }
+        ).analyze()
+        out = tft.aggregate(
+            lambda y_input: {"y": y_input.sum(axis=0)}, df.group_by("k")
+        )
+        rows = sorted(out.collect(), key=lambda r: r.k)
+        np.testing.assert_allclose(rows[0].y, [4.0, 6.0])
+        np.testing.assert_allclose(rows[1].y, [5.0, 6.0])
+
+    def test_single_group(self):
+        df = tft.TensorFrame.from_columns(
+            {"k": np.zeros(4, dtype=np.int64), "x": np.arange(4.0)}
+        )
+        out = tft.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)}, df.group_by("k")
+        ).collect()
+        assert len(out) == 1 and out[0].x == 6.0
+
+    def test_many_groups(self):
+        n = 101
+        df = tft.TensorFrame.from_columns(
+            {
+                "k": np.arange(n, dtype=np.int64) % 13,
+                "x": np.ones(n),
+            }
+        )
+        out = tft.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)}, df.group_by("k")
+        )
+        total = sum(r.x for r in out.collect())
+        assert total == n
+
+    def test_key_cannot_be_input(self):
+        df = tft.TensorFrame.from_columns(
+            {"k": np.arange(3, dtype=np.int64)}
+        )
+        with pytest.raises(ValueError, match="key and input"):
+            tft.aggregate(
+                lambda k_input: {"k": k_input.sum(axis=0)}, df.group_by("k")
+            )
+
+
+class TestGraphSerializationPath:
+    def test_map_from_loaded_graph(self, tmp_path):
+        # analog of loading a frozen GraphDef (PythonInterface.scala:115-118)
+        df = scalar_df(4)
+        with tft.graph():
+            x = tft.block(df, "x")
+            g = tft.build_graph((x * 3.0).named("z"))
+        p = str(tmp_path / "g.bin")
+        tft.save_graph(g, p)
+        g2 = tft.load_graph(p)
+        out = tft.map_blocks(g2, df).collect()
+        assert [r.z for r in out] == [0.0, 3.0, 6.0, 9.0]
