@@ -1,0 +1,84 @@
+"""Benchmark: rows/sec/chip on ``map_blocks`` (BASELINE.json primary metric).
+
+Workload: MNIST-logistic-regression scoring via ``map_blocks`` on a frozen
+model — BASELINE config 3, the reference's flagship scoring path (variable
+freezing + per-partition Session.run, reference ``core.py:41-55``). Here the
+frozen model is a captured XLA program with parameters as constants.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+comparison point is the same scoring computed by numpy on the host CPU of
+this machine — a stand-in for the reference's CPU execution path.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _numpy_baseline(x, w, b, iters=3):
+    """CPU scoring throughput (argmax(x @ w + b))."""
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        np.argmax(x @ w + b, axis=-1)
+    dt = (time.perf_counter() - t0) / iters
+    return x.shape[0] / dt
+
+
+def main():
+    import jax
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.models import MLPClassifier
+
+    n_rows, n_features, n_classes = 200_000, 784, 10
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, n_features)).astype(np.float32)
+
+    clf = MLPClassifier.init(0, [n_features, n_classes])
+    w, b = clf.params[0]["w"], clf.params[0]["b"]
+
+    df = tft.TensorFrame.from_columns({"features": x}).analyze()
+
+    def run():
+        scored = clf.score_frame(df, "features")
+        # force full materialization (device compute + host transfer)
+        return scored.column_block("prediction")
+
+    preds = run()  # warmup: compile + execute
+    ref = np.argmax(x @ w + b, axis=-1)
+    # TPU MXU matmuls run bf16 by default, so near-tie argmaxes may flip vs
+    # the f32 numpy oracle; 99% agreement is the sanity bar, not bit parity
+    assert (np.asarray(preds) == ref).mean() > 0.99, "scoring mismatch"
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run()
+    dt = (time.perf_counter() - t0) / iters
+    rows_per_sec = n_rows / dt
+
+    cpu_rows_per_sec = _numpy_baseline(x, w, b)
+
+    print(
+        json.dumps(
+            {
+                "metric": "map_blocks_scoring_rows_per_sec_per_chip",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / cpu_rows_per_sec, 3),
+                "detail": {
+                    "workload": "MNIST-LR scoring, 200k x 784 f32 (BASELINE config 3)",
+                    "device": str(jax.devices()[0]),
+                    "cpu_numpy_rows_per_sec": round(cpu_rows_per_sec, 1),
+                    "seconds_per_pass": round(dt, 4),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -250,15 +250,18 @@ def map_blocks(
     def thunk() -> TensorFrame:
         pieces: Dict[str, List[np.ndarray]] = {n: [] for n in fetch_names}
         part_sizes: List[int] = []
+        # device-resident columns: transferred once, sliced on device
+        dev_cols = {}
+        for ph, col in binding.items():
+            parent.column_block(col, None)  # rejects ragged/binary
+            dev_cols[ph] = parent.column_data(col).device()
         for p in range(parent.num_partitions):
             lo, hi = parent.partition_bounds()[p]
             n = hi - lo
             if n == 0:
                 part_sizes.append(0)
                 continue
-            feed = {
-                ph: parent.column_block(col, p) for ph, col in binding.items()
-            }
+            feed = {ph: dev_cols[ph][lo:hi] for ph in binding}
             res = jit_fn(feed)
             out_n = None
             for name in fetch_names:
@@ -431,15 +434,16 @@ def reduce_blocks(fetches, dframe: TensorFrame):
     binding = validate_reduce_block_graph(g, dframe.schema)
     _ensure_precision(g, dframe.schema)
     jit_fn = _jitted(g)
+    dev_cols = {}
+    for f, col in binding.items():
+        dframe.column_block(col, None)  # rejects ragged/binary
+        dev_cols[f] = dframe.column_data(col).device()
     partials: List[Dict[str, Any]] = []
     for p in range(dframe.num_partitions):
         lo, hi = dframe.partition_bounds()[p]
         if hi - lo == 0:
             continue
-        feed = {
-            f"{f}_input": dframe.column_block(col, p)
-            for f, col in binding.items()
-        }
+        feed = {f"{f}_input": dev_cols[f][lo:hi] for f in binding}
         partials.append(jit_fn(feed))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
@@ -499,14 +503,16 @@ def reduce_rows(fetches, dframe: TensorFrame):
         merge_jit = jax.jit(merge)
         g._merge_cache = merge_jit
 
+    dev_cols = {}
+    for f, col in binding.items():
+        dframe.column_block(col, None)  # rejects ragged/binary
+        dev_cols[f] = dframe.column_data(col).device()
     partials: List[Dict[str, Any]] = []
     for p in range(dframe.num_partitions):
         lo, hi = dframe.partition_bounds()[p]
         if hi - lo == 0:
             continue
-        feed = {
-            f: dframe.column_block(col, p) for f, col in binding.items()
-        }
+        feed = {f: dev_cols[f][lo:hi] for f in binding}
         partials.append(fold_block(feed))
     if not partials:
         raise ValueError("reduce_rows on an empty frame")

@@ -77,14 +77,30 @@ def _as_cell(v) -> Any:
 
 class _ColumnData:
     """One column's storage. ``dense`` is an ndarray [n, *cell]; ``cells`` is
-    a list of per-row payloads (ragged / binary)."""
+    a list of per-row payloads (ragged / binary). ``device()`` memoizes the
+    on-device copy — columns are immutable, so a frame that is fed to the
+    engine repeatedly pays the host->device transfer once (the reference
+    re-marshals every Session.run, ``TFDataOps.scala:27-59``)."""
 
-    __slots__ = ("dense", "cells", "is_binary")
+    __slots__ = ("dense", "cells", "is_binary", "_device_arr")
 
     def __init__(self, dense=None, cells=None, is_binary=False):
         self.dense: Optional[np.ndarray] = dense
         self.cells: Optional[List[Any]] = cells
         self.is_binary = is_binary
+        self._device_arr = None
+
+    def device(self):
+        """The dense column as a device-resident jax array (memoized)."""
+        if self.dense is None:
+            raise ValueError("only dense columns have a device form")
+        if self._device_arr is None or (
+            self._device_arr.dtype != self.dense.dtype
+        ):
+            import jax
+
+            self._device_arr = jax.device_put(self.dense)
+        return self._device_arr
 
     @property
     def num_rows(self) -> int:
@@ -375,6 +391,23 @@ class TensorFrame:
     def repartition(self, n: int) -> "TensorFrame":
         self._force()
         return TensorFrame(self._columns, self._info, num_partitions=n)
+
+    def unpersist_device(self) -> "TensorFrame":
+        """Release the memoized device (HBM) copies of this frame's columns.
+
+        Column storage is shared by derived frames (``select`` etc.), so
+        this frees the device buffers for all of them; the next engine op
+        re-transfers on demand. Host data is unaffected."""
+        self._force()
+        for cd in self._columns.values():
+            cd._device_arr = None
+        return self
+
+    def slice_rows(self, lo: int, hi: int) -> "TensorFrame":
+        """Contiguous row slice as a single-partition frame."""
+        self._force()
+        cols = {n: cd.slice(lo, hi) for n, cd in self._columns.items()}
+        return TensorFrame(cols, self._info)
 
     def filter_rows(self, mask: np.ndarray) -> "TensorFrame":
         self._force()

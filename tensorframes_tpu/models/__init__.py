@@ -1,0 +1,19 @@
+"""Model zoo: the reference's model-scoring workloads as first-class models."""
+
+from .mlp import (
+    MLPClassifier,
+    init_mlp,
+    mlp_apply,
+    mlp_logits,
+    mlp_loss,
+    softmax_cross_entropy,
+)
+
+__all__ = [
+    "MLPClassifier",
+    "init_mlp",
+    "mlp_apply",
+    "mlp_logits",
+    "mlp_loss",
+    "softmax_cross_entropy",
+]

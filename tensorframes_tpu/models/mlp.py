@@ -1,0 +1,172 @@
+"""MLP / logistic-regression models as pure JAX functions.
+
+The reference has no model code of its own — its model workloads are frozen
+TF graphs scored through the dataframe ops: MNIST logistic regression via
+``map_blocks`` (variable-freezing path, reference ``core.py:41-55``) and
+VGG/Inception image scoring via ``map_rows``
+(``tensorframes_snippets/read_image.py:147-167``). This module provides the
+equivalent first-class models: parameters are pytrees, scoring is a captured
+graph dispatched through ``map_blocks``, and training composes with
+:mod:`tensorframes_tpu.parallel.training` for mesh-sharded SGD.
+
+A zero-hidden-layer MLP is exactly the reference's logistic-regression
+scoring workload (BASELINE.md config 3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "init_mlp",
+    "mlp_apply",
+    "mlp_logits",
+    "softmax_cross_entropy",
+    "mlp_loss",
+    "MLPClassifier",
+]
+
+Params = List[Dict[str, Any]]
+
+
+def init_mlp(
+    seed: int, layer_sizes: Sequence[int], dtype=np.float32
+) -> Params:
+    """He-initialized dense layers: ``layer_sizes = [din, h1, ..., dout]``."""
+    if len(layer_sizes) < 2:
+        raise ValueError("layer_sizes needs at least [din, dout]")
+    rng = np.random.default_rng(seed)
+    params: Params = []
+    for fan_in, fan_out in zip(layer_sizes[:-1], layer_sizes[1:]):
+        w = rng.normal(0.0, np.sqrt(2.0 / fan_in), (fan_in, fan_out))
+        params.append(
+            {
+                "w": w.astype(dtype),
+                "b": np.zeros((fan_out,), dtype=dtype),
+            }
+        )
+    return params
+
+
+def mlp_logits(params: Params, x):
+    """Forward pass to logits. Matmuls stay batched 2-D so XLA tiles them
+    onto the MXU; bf16/f32 inputs pass through unchanged."""
+    import jax
+
+    h = x
+    for i, layer in enumerate(params):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def mlp_apply(params: Params, x):
+    """Class probabilities."""
+    import jax
+
+    return jax.nn.softmax(mlp_logits(params, x), axis=-1)
+
+
+def softmax_cross_entropy(logits, labels):
+    """Mean CE over the batch; ``labels`` are int class ids."""
+    import jax
+    import jax.numpy as jnp
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)
+    return -jnp.mean(picked)
+
+
+def mlp_loss(params: Params, x, y):
+    return softmax_cross_entropy(mlp_logits(params, x), y)
+
+
+class MLPClassifier:
+    """Convenience wrapper: holds params, scores frames through the engine.
+
+    ``score_frame`` is the analog of scoring a frozen graph with
+    ``tfs.map_blocks`` (reference ``core.py:41-55`` + BASELINE config 3):
+    the parameters are closed over as constants in the captured program,
+    exactly like the reference freezes ``tf.Variable`` into the GraphDef.
+    """
+
+    def __init__(self, params: Params):
+        self._params = params
+        self._graph_cache: Dict[Any, Any] = {}
+
+    @property
+    def params(self) -> Params:
+        return self._params
+
+    @params.setter
+    def params(self, new_params: Params) -> None:
+        # captured scoring graphs close over the old weights; drop them
+        self._params = new_params
+        self._graph_cache.clear()
+
+    @staticmethod
+    def init(seed: int, layer_sizes: Sequence[int], dtype=np.float32):
+        return MLPClassifier(init_mlp(seed, layer_sizes, dtype))
+
+    def _scoring_graph(
+        self, df, col, prediction_col, probabilities_col
+    ):
+        """CapturedGraph for scoring, memoized so repeated scoring reuses one
+        compiled program (the reference broadcasts one frozen GraphDef and
+        reuses it per partition; rebuilding the capture per call would force
+        an XLA recompile per call)."""
+        from ..capture import CapturedGraph
+        from ..schema import Unknown
+
+        info = df.schema[col]
+        key = (
+            col,
+            prediction_col,
+            probabilities_col,
+            info.scalar_type.name,
+            info.cell_shape.dims,
+        )
+        if key in self._graph_cache:
+            return self._graph_cache[key]
+        import jax
+        import jax.numpy as jnp
+
+        params = self.params
+
+        def fn(x):
+            logits = mlp_logits(params, x)
+            out = {prediction_col: jnp.argmax(logits, axis=-1).astype(jnp.int32)}
+            if probabilities_col:
+                out[probabilities_col] = jax.nn.softmax(logits, axis=-1)
+            return out
+
+        g = CapturedGraph.from_callable(
+            fn,
+            {"x": (info.scalar_type, info.block_shape.with_lead(Unknown))},
+            inputs_map={"x": col},
+        )
+        self._graph_cache[key] = g
+        return g
+
+    def score_frame(
+        self,
+        df,
+        col: str,
+        prediction_col: str = "prediction",
+        probabilities_col: Optional[str] = None,
+        distributed: bool = False,
+        mesh=None,
+    ):
+        """Append argmax predictions (and optionally probabilities) to the
+        frame via ``map_blocks``."""
+        g = self._scoring_graph(df, col, prediction_col, probabilities_col)
+        if distributed:
+            from ..parallel import map_blocks as dmap_blocks
+
+            return dmap_blocks(g, df, mesh=mesh)
+        from ..engine import map_blocks
+
+        return map_blocks(g, df)

@@ -1,0 +1,20 @@
+"""Distributed execution over TPU device meshes.
+
+Replaces the reference's Spark plane (partitions/broadcast/shuffle/driver
+funnel, SURVEY §2.5) with ``shard_map`` programs and XLA collectives.
+"""
+
+from .mesh import make_mesh, default_mesh, data_axis
+from .distributed import map_blocks, reduce_blocks, reduce_rows, aggregate
+from .training import ShardedSGDTrainer
+
+__all__ = [
+    "make_mesh",
+    "default_mesh",
+    "data_axis",
+    "map_blocks",
+    "reduce_blocks",
+    "reduce_rows",
+    "aggregate",
+    "ShardedSGDTrainer",
+]

@@ -1,0 +1,62 @@
+"""Device meshes: the cluster substrate.
+
+The reference's "cluster" is Spark: partitions scheduled onto executors,
+results funneled to the driver (``DebugRowOps.scala:377-391,524``). The
+TPU-native substrate is a ``jax.sharding.Mesh``: a named, possibly
+multi-dimensional arrangement of chips; collectives ride ICI inside a pod
+and DCN across hosts (SURVEY §2.5). One table shard maps to one chip along
+the ``dp`` (data/rows) axis; other axes (``tp``...) are reserved for model
+sharding in :mod:`tensorframes_tpu.parallel.training`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["make_mesh", "default_mesh", "data_axis"]
+
+#: canonical name of the row/data-parallel mesh axis
+DATA_AXIS = "dp"
+
+
+def data_axis() -> str:
+    return DATA_AXIS
+
+
+def make_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a Mesh.
+
+    ``shape``: ordered axis-name -> size dict (e.g. ``{"dp": 4, "tp": 2}``);
+    defaults to a 1-D ``{"dp": <all devices>}`` mesh. ``devices`` defaults to
+    ``jax.devices()``."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if shape is None:
+        shape = {DATA_AXIS: len(devs)}
+    sizes = tuple(shape.values())
+    n = int(np.prod(sizes))
+    if n > len(devs):
+        raise ValueError(
+            f"Mesh shape {shape} needs {n} devices; only {len(devs)} available"
+        )
+    grid = np.array(devs[:n]).reshape(sizes)
+    return Mesh(grid, tuple(shape.keys()))
+
+
+_default_mesh = None
+
+
+def default_mesh():
+    """Process-wide 1-D data mesh over all devices (cached)."""
+    global _default_mesh
+    import jax
+
+    if _default_mesh is None or _default_mesh.devices.size != len(jax.devices()):
+        _default_mesh = make_mesh()
+    return _default_mesh

@@ -11,12 +11,21 @@ Env vars must be set before jax initializes its backends, hence here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the environment points at a TPU tunnel: unit tests
+# exercise sharding on 8 virtual devices, not the single real chip.
+# The image's sitecustomize imports jax at interpreter start, so the env-var
+# route alone is too late — flip the live jax config as well (backends are
+# not initialized until the first jax.devices()/computation).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
