@@ -1,0 +1,50 @@
+"""Geometric & harmonic means over a frame column.
+
+Port of the reference snippet
+``/root/reference/src/main/python/tensorframes_snippets/geom_mean.py:26-49``:
+log/invert in a map, sum via keyed aggregation, finish on the host.
+
+Run: ``python examples/geom_mean.py`` (any backend; CPU is fine).
+"""
+
+import numpy as np
+
+import tensorframes_tpu as tft
+
+
+def geometric_mean(df, col: str) -> float:
+    import jax.numpy as jnp
+
+    df2 = tft.map_blocks(
+        lambda x: {"logx": jnp.log(x), "cnt": jnp.ones_like(x)},
+        df,
+        feed_dict={"x": col},
+    )
+    logsum = tft.reduce_blocks(
+        lambda logx_input: {"logx": logx_input.sum()}, df2
+    )
+    n = df.num_rows
+    return float(np.exp(logsum / n))
+
+
+def harmonic_mean(df, col: str) -> float:
+    df2 = tft.map_blocks(
+        lambda x: {"invx": 1.0 / x}, df, feed_dict={"x": col}
+    )
+    invsum = tft.reduce_blocks(
+        lambda invx_input: {"invx": invx_input.sum()}, df2
+    )
+    return float(df.num_rows / invsum)
+
+
+def main():
+    data = np.array([1.0, 2.0, 4.0, 8.0])
+    df = tft.TensorFrame.from_columns({"x": data})
+    gm = geometric_mean(df, "x")
+    hm = harmonic_mean(df, "x")
+    print(f"geometric mean: {gm:.6f} (expect {data.prod() ** (1 / 4):.6f})")
+    print(f"harmonic  mean: {hm:.6f} (expect {4 / (1 / data).sum():.6f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Distributed k-means demo + micro-benchmark.
+
+Port of the reference's
+``/root/reference/src/main/python/tensorframes_snippets/kmeans_demo.py:198-255``
+harness: synthetic blobs, framework k-means (in-graph pre-aggregation +
+global reduce) vs a pure-numpy Lloyd baseline, with wall-clock timings.
+
+Run: ``python examples/kmeans_demo.py [n_rows] [dim] [k]``
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.models import assign_clusters, kmeans
+
+
+def numpy_kmeans(data, k, iters, seed):
+    rng = np.random.default_rng(seed)
+    c = data[rng.choice(len(data), k, replace=False)].copy()
+    for _ in range(iters):
+        d2 = ((data[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        closest = d2.argmin(1)
+        for j in range(k):
+            m = closest == j
+            if m.any():
+                c[j] = data[m].mean(0)
+    return c
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    dim = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    k = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+    iters = 10
+    rng = np.random.default_rng(42)
+    centers = rng.normal(0, 10, (k, dim))
+    data = (
+        centers[rng.integers(0, k, n)] + rng.normal(0, 1, (n, dim))
+    ).astype(np.float32)
+
+    df = tft.TensorFrame.from_columns({"features": data}, num_partitions=4)
+    df = tft.analyze(df)
+
+    t0 = time.perf_counter()
+    centroids, history = kmeans(df, "features", k=k, num_iters=iters, seed=0)
+    t_tft = time.perf_counter() - t0
+    print(f"tensorframes_tpu kmeans: {t_tft:.3f}s, final shift {history[-1]:.4f}")
+
+    t0 = time.perf_counter()
+    numpy_kmeans(data, k, iters, 0)
+    t_np = time.perf_counter() - t0
+    print(f"numpy kmeans:            {t_np:.3f}s  ({t_np / t_tft:.2f}x)")
+
+    assigned = assign_clusters(df, "features", centroids)
+    counts = np.bincount(
+        np.asarray(assigned.column_block("closest_centroid")), minlength=k
+    )
+    print("cluster sizes:", counts.tolist())
+
+
+if __name__ == "__main__":
+    main()

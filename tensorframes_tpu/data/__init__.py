@@ -1,0 +1,21 @@
+"""Host data plane: native packing kernels + ragged buffers."""
+
+from .packer import (
+    native_available,
+    pad_ragged,
+    unpad_ragged,
+    gather_rows,
+    scatter_rows,
+    gather_ragged_pad,
+)
+from .ragged import RaggedBuffer
+
+__all__ = [
+    "native_available",
+    "pad_ragged",
+    "unpad_ragged",
+    "gather_rows",
+    "scatter_rows",
+    "gather_ragged_pad",
+    "RaggedBuffer",
+]

@@ -1,0 +1,252 @@
+"""ctypes bindings for the native packer, with numpy fallbacks.
+
+The native library is built on demand with the system toolchain (g++) and
+cached next to the source; environments without a compiler silently use the
+numpy implementations (same results, slower on wide ragged data). This
+mirrors how the reference leans on a prebuilt native artifact for its
+buffer hot loops (the TF JNI `Tensor.create`/`writeTo` paths,
+``datatypes.scala:344-370``) while keeping the JVM-only path functional.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..utils import get_logger
+
+__all__ = [
+    "native_available",
+    "pad_ragged",
+    "unpad_ragged",
+    "gather_rows",
+    "scatter_rows",
+    "gather_ragged_pad",
+]
+
+logger = get_logger("data.packer")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "packer.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libtfspacker.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB]
+    try:
+        res = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.info("native packer build unavailable: %s", e)
+        return False
+    if res.returncode != 0:
+        logger.warning("native packer build failed:\n%s", res.stderr)
+        return False
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            logger.warning("native packer load failed: %s", e)
+            return None
+        c_char_p = ctypes.c_char_p
+        c_i64 = ctypes.c_int64
+        p_i64 = ctypes.POINTER(ctypes.c_int64)
+        lib.tfs_pad_ragged.argtypes = [
+            c_char_p, p_i64, c_i64, c_i64, c_i64, c_char_p, c_char_p,
+        ]
+        lib.tfs_unpad_ragged.argtypes = [
+            c_char_p, p_i64, c_i64, c_i64, c_i64, c_char_p,
+        ]
+        lib.tfs_gather_rows.argtypes = [c_char_p, c_i64, p_i64, c_i64, c_char_p]
+        lib.tfs_scatter_rows.argtypes = [c_char_p, c_i64, p_i64, c_i64, c_char_p]
+        lib.tfs_gather_ragged_pad.argtypes = [
+            c_char_p, p_i64, p_i64, c_i64, c_i64, c_i64, c_char_p, c_char_p,
+        ]
+        lib.tfs_packer_abi_version.restype = c_i64
+        if lib.tfs_packer_abi_version() != 1:
+            logger.warning("native packer ABI mismatch; using numpy fallback")
+            return None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_char_p)
+
+
+def _i64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _check_flat(flat: np.ndarray, offsets: np.ndarray):
+    if flat.ndim != 1 or not flat.flags.c_contiguous:
+        raise ValueError("flat must be a contiguous 1-D array")
+    if offsets.dtype != np.int64 or offsets.ndim != 1:
+        raise ValueError("offsets must be a 1-D int64 array")
+
+
+def pad_ragged(
+    flat: np.ndarray,
+    offsets: np.ndarray,
+    max_len: Optional[int] = None,
+    pad_value=0,
+) -> np.ndarray:
+    """Arrow-style (flat, offsets) ragged rows -> dense [n, max_len] matrix."""
+    _check_flat(flat, offsets)
+    n = len(offsets) - 1
+    lens = np.diff(offsets)
+    ml = int(max_len) if max_len is not None else (int(lens.max()) if n else 0)
+    if n and int(lens.max()) > ml:
+        raise ValueError(f"max_len {ml} smaller than longest row {int(lens.max())}")
+    out = np.empty((n, ml), dtype=flat.dtype)
+    lib = _load()
+    pad = np.asarray(pad_value, dtype=flat.dtype)
+    if lib is not None:
+        lib.tfs_pad_ragged(
+            _ptr(flat), _i64ptr(offsets), n, ml, flat.dtype.itemsize,
+            _ptr(pad.reshape(1)), _ptr(out),
+        )
+        return out
+    out[:] = pad
+    for i in range(n):
+        row = flat[offsets[i] : offsets[i + 1]]
+        out[i, : len(row)] = row
+    return out
+
+
+def unpad_ragged(padded: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Dense [n, max_len] + per-row lengths -> flat concatenated values."""
+    if padded.ndim != 2 or not padded.flags.c_contiguous:
+        raise ValueError("padded must be a contiguous 2-D array")
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    if len(lengths) != padded.shape[0]:
+        raise ValueError(
+            f"lengths has {len(lengths)} entries for {padded.shape[0]} rows"
+        )
+    if len(lengths) and (
+        int(lengths.max()) > padded.shape[1] or int(lengths.min()) < 0
+    ):
+        raise ValueError(
+            f"lengths must be within [0, {padded.shape[1]}]; got "
+            f"[{int(lengths.min())}, {int(lengths.max())}]"
+        )
+    total = int(lengths.sum())
+    out = np.empty(total, dtype=padded.dtype)
+    lib = _load()
+    if lib is not None:
+        lib.tfs_unpad_ragged(
+            _ptr(padded), _i64ptr(lengths), padded.shape[0],
+            padded.shape[1], padded.dtype.itemsize, _ptr(out),
+        )
+        return out
+    off = 0
+    for i, ln in enumerate(lengths):
+        out[off : off + ln] = padded[i, :ln]
+        off += int(ln)
+    return out
+
+
+def _check_idx(idx: np.ndarray, n_rows: int) -> None:
+    if len(idx) and (int(idx.min()) < 0 or int(idx.max()) >= n_rows):
+        raise IndexError(
+            f"row index out of range [0, {n_rows}): "
+            f"[{int(idx.min())}, {int(idx.max())}]"
+        )
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """out[k] = src[idx[k]] for fixed-width rows (any trailing dims)."""
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    _check_idx(idx, src.shape[0])
+    out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
+    lib = _load()
+    if lib is not None and src.ndim >= 1:
+        row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.dtype.itemsize
+        lib.tfs_gather_rows(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))
+        return out
+    return src[idx]
+
+
+def scatter_rows(src: np.ndarray, idx: np.ndarray, n_rows: int) -> np.ndarray:
+    """out[idx[k]] = src[k]; inverse of :func:`gather_rows` for a
+    permutation index."""
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    if len(idx) != src.shape[0]:
+        raise ValueError(f"idx has {len(idx)} entries for {src.shape[0]} rows")
+    _check_idx(idx, n_rows)
+    out = np.empty((n_rows,) + src.shape[1:], dtype=src.dtype)
+    lib = _load()
+    if lib is not None:
+        row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.dtype.itemsize
+        lib.tfs_scatter_rows(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))
+        return out
+    out[idx] = src
+    return out
+
+
+def gather_ragged_pad(
+    flat: np.ndarray,
+    offsets: np.ndarray,
+    idx: np.ndarray,
+    max_len: int,
+    pad_value=0,
+) -> np.ndarray:
+    """Gather ragged rows by index into a dense padded matrix (the map_rows
+    shape-bucket stacking step)."""
+    _check_flat(flat, offsets)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    n_rows = len(offsets) - 1
+    if len(idx) and (int(idx.min()) < 0 or int(idx.max()) >= n_rows):
+        raise IndexError(
+            f"gather index out of range [0, {n_rows}): "
+            f"[{int(idx.min())}, {int(idx.max())}]"
+        )
+    lens = np.diff(offsets)
+    if len(idx) and int(lens[idx].max()) > int(max_len):
+        raise ValueError(
+            f"max_len {int(max_len)} smaller than longest selected row "
+            f"{int(lens[idx].max())}"
+        )
+    out = np.empty((len(idx), int(max_len)), dtype=flat.dtype)
+    lib = _load()
+    pad = np.asarray(pad_value, dtype=flat.dtype)
+    if lib is not None:
+        lib.tfs_gather_ragged_pad(
+            _ptr(flat), _i64ptr(offsets), _i64ptr(idx), len(idx),
+            int(max_len), flat.dtype.itemsize, _ptr(pad.reshape(1)), _ptr(out),
+        )
+        return out
+    out[:] = pad
+    for k, i in enumerate(idx):
+        row = flat[offsets[i] : offsets[i + 1]]
+        out[k, : len(row)] = row
+    return out

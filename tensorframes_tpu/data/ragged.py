@@ -1,0 +1,96 @@
+"""Arrow-style ragged buffers: flat values + offsets.
+
+The TPU-friendly columnar form for variable-length rows (cf. Arrow
+ListArray): one contiguous value buffer + an int64 offsets array. All
+pad/bucket/slice operations become byte moves handled by the native packer.
+This replaces the reference's per-row boxed handling of ragged vectors
+(``TFDataOps.scala:90-113``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from . import packer
+
+__all__ = ["RaggedBuffer"]
+
+
+class RaggedBuffer:
+    """Immutable (flat, offsets) ragged rows of 1-D cells."""
+
+    __slots__ = ("flat", "offsets")
+
+    def __init__(self, flat: np.ndarray, offsets: np.ndarray):
+        flat = np.ascontiguousarray(flat)
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        if flat.ndim != 1:
+            raise ValueError("flat must be 1-D")
+        if offsets.ndim != 1 or len(offsets) == 0 or offsets[0] != 0:
+            raise ValueError("offsets must be 1-D starting at 0")
+        if offsets[-1] != len(flat):
+            raise ValueError("offsets must end at len(flat)")
+        self.flat = flat
+        self.offsets = offsets
+
+    @staticmethod
+    def from_cells(cells: Sequence[np.ndarray]) -> "RaggedBuffer":
+        lens = np.fromiter(
+            (len(c) for c in cells), count=len(cells), dtype=np.int64
+        )
+        offsets = np.zeros(len(cells) + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        flat = (
+            np.concatenate([np.ravel(c) for c in cells])
+            if cells
+            else np.empty(0)
+        )
+        return RaggedBuffer(flat, offsets)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.offsets) - 1
+
+    @property
+    def lengths(self) -> np.ndarray:
+        return np.diff(self.offsets)
+
+    @property
+    def max_len(self) -> int:
+        return int(self.lengths.max()) if self.num_rows else 0
+
+    def cell(self, i: int) -> np.ndarray:
+        return self.flat[self.offsets[i] : self.offsets[i + 1]]
+
+    def cells(self) -> List[np.ndarray]:
+        return [self.cell(i) for i in range(self.num_rows)]
+
+    def pad(self, max_len: Optional[int] = None, pad_value=0) -> np.ndarray:
+        """Dense [n, max_len] matrix with padding."""
+        return packer.pad_ragged(self.flat, self.offsets, max_len, pad_value)
+
+    def gather_pad(
+        self, idx: np.ndarray, max_len: Optional[int] = None, pad_value=0
+    ) -> np.ndarray:
+        """Selected rows stacked into a dense padded matrix."""
+        idx = np.ascontiguousarray(idx, dtype=np.int64)
+        ml = (
+            int(max_len)
+            if max_len is not None
+            else (int(self.lengths[idx].max()) if len(idx) else 0)
+        )
+        return packer.gather_ragged_pad(
+            self.flat, self.offsets, idx, ml, pad_value
+        )
+
+    @staticmethod
+    def from_padded(padded: np.ndarray, lengths: np.ndarray) -> "RaggedBuffer":
+        lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+        offsets = np.zeros(len(lengths) + 1, dtype=np.int64)
+        np.cumsum(lengths, out=offsets[1:])
+        return RaggedBuffer(packer.unpad_ragged(padded, lengths), offsets)
+
+    def __repr__(self):
+        return f"RaggedBuffer(rows={self.num_rows}, values={len(self.flat)})"

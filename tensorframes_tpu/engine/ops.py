@@ -28,6 +28,7 @@ row count (``TrimmingOperationsSuite.scala:25-39``).
 from __future__ import annotations
 
 import inspect
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -68,6 +69,9 @@ logger = get_logger("engine")
 block = _dsl.block
 row = _dsl.row
 
+#: per-callable CapturedGraph memo (see _graph_from_callable)
+_callable_graphs: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
 
 # ---------------------------------------------------------------------------
 # graph normalization: Node(s) | CapturedGraph | plain callable
@@ -80,11 +84,17 @@ def _as_graph(
     *,
     cell_inputs: bool,
     feed_dict: Optional[Dict[str, str]] = None,
+    constants: Optional[Dict[str, Any]] = None,
 ) -> CapturedGraph:
     """Accept the three frontend forms and return a CapturedGraph.
 
     ``cell_inputs=False``: placeholders for a plain callable get *block*
-    shapes (lead Unknown); ``True``: cell shapes (map_rows / reduce_rows)."""
+    shapes (lead Unknown); ``True``: cell shapes (map_rows / reduce_rows).
+    ``constants``: placeholder name -> host array fed per call instead of a
+    column — unlike DSL constants (baked into the program, forcing a
+    recompile when the value changes) these are ordinary traced arguments,
+    so iterative algorithms reuse one compiled program (e.g. k-means
+    centroids each Lloyd step)."""
     if isinstance(fetches, CapturedGraph):
         g = fetches
     elif isinstance(fetches, Node):
@@ -94,7 +104,7 @@ def _as_graph(
     ):
         g = build_graph(list(fetches))
     elif callable(fetches):
-        g = _graph_from_callable(fetches, df, cell_inputs, feed_dict)
+        g = _graph_from_callable(fetches, df, cell_inputs, feed_dict, constants)
     else:
         raise TypeError(
             f"fetches must be Node(s), a CapturedGraph, or a callable; got "
@@ -110,9 +120,13 @@ def _graph_from_callable(
     df: TensorFrame,
     cell_inputs: bool,
     feed_dict: Optional[Dict[str, str]],
+    constants: Optional[Dict[str, Any]] = None,
 ) -> CapturedGraph:
     """Plain-function frontend: parameter names are placeholder names, bound
-    to columns directly or via feed_dict / reduce suffixes."""
+    to columns directly or via feed_dict / reduce suffixes, or to per-call
+    ``constants`` arrays."""
+    from ..schema import for_numpy_dtype
+
     params = [
         p.name
         for p in inspect.signature(fn).parameters.values()
@@ -123,6 +137,10 @@ def _graph_from_callable(
     bound: Dict[str, str] = {}
     missing = []
     for p in params:
+        if constants and p in constants:
+            arr = np.asarray(constants[p])
+            specs[p] = (for_numpy_dtype(arr.dtype), Shape(arr.shape))
+            continue
         col = resolve_column(p, feed_dict or {}, df.schema.names)
         if col is None:
             missing.append(p)
@@ -139,6 +157,20 @@ def _graph_from_callable(
         specs[p] = (info.scalar_type, shape)
     if missing:
         raise InputNotFoundError(missing, df.schema.names)
+    # memoize per function object + spec signature: a fn defined once and
+    # passed to an op repeatedly (e.g. inside an iterative algorithm) keeps
+    # one CapturedGraph and therefore one compiled program
+    cache_key = (
+        cell_inputs,
+        tuple(sorted((k, st.name, s.dims) for k, (st, s) in specs.items())),
+        tuple(sorted((feed_dict or {}).items())),
+    )
+    try:
+        per_fn = _callable_graphs.setdefault(fn, {})
+    except TypeError:  # unhashable/unweakrefable callables skip the cache
+        per_fn = {}
+    if cache_key in per_fn:
+        return per_fn[cache_key]
     probe_feed = None
     if any(st.name == "binary" for st, _ in specs.values()):
         # binary programs cannot be abstract-traced; discover outputs by
@@ -146,7 +178,9 @@ def _graph_from_callable(
         if df.num_rows == 0:
             raise ValueError("cannot capture a binary-input program on an empty frame")
         probe_feed = {p: df.column_data(c).cell(0) for p, c in bound.items()}
-    return CapturedGraph.from_callable(fn, specs, probe_feed=probe_feed)
+    g = CapturedGraph.from_callable(fn, specs, probe_feed=probe_feed)
+    per_fn[cache_key] = g
+    return g
 
 
 def _jitted(g: CapturedGraph):
@@ -167,6 +201,19 @@ def _jitted_vmap(g: CapturedGraph):
         j = jax.jit(jax.vmap(g.fn))
         g._jit_vmap_cache = j
     return j
+
+
+def _block_feeder(cd):
+    """Per-partition feed source for a dense column: the memoized device
+    copy (sliced on device) when the column fits the device-cache budget,
+    else host slices streamed one block at a time so HBM stays bounded by a
+    single block."""
+    from ..utils import get_config
+
+    if cd.dense.nbytes <= get_config().device_cache_bytes:
+        dev = cd.device()
+        return lambda lo, hi: dev[lo:hi]
+    return lambda lo, hi: cd.dense[lo:hi]
 
 
 def _ensure_precision(g: CapturedGraph, schema: FrameInfo) -> None:
@@ -206,6 +253,7 @@ def map_blocks(
     dframe: TensorFrame,
     trim: bool = False,
     feed_dict: Optional[Dict[str, str]] = None,
+    constants: Optional[Dict[str, Any]] = None,
 ) -> TensorFrame:
     """Transform the frame block by block; fetches become new columns
     (``trim=False``) or the entire output (``trim=True``, row count may
@@ -213,9 +261,16 @@ def map_blocks(
 
     Each partition block is one XLA program execution; XLA's jit cache keys
     on the block shape, so frames with equal-sized partitions compile once.
+    ``constants`` feed placeholders with per-call host arrays (same shape ->
+    no recompile), for iterative algorithms like k-means centroids.
     """
-    g = _as_graph(fetches, dframe, cell_inputs=False, feed_dict=feed_dict)
-    binding = validate_map_inputs(g, dframe.schema, block=True)
+    g = _as_graph(
+        fetches, dframe, cell_inputs=False, feed_dict=feed_dict,
+        constants=constants,
+    )
+    binding = validate_map_inputs(
+        g, dframe.schema, block=True, constants=set(constants or ())
+    )
     # ragged/binary columns are rejected when blocks are materialized in the
     # thunk (column_block raises), keeping construction metadata-only/lazy
     _ensure_precision(g, dframe.schema)
@@ -247,21 +302,26 @@ def map_blocks(
     jit_fn = _jitted(g)
     parent = dframe
 
+    const_feed = {
+        ph: np.asarray(v) for ph, v in (constants or {}).items()
+    }
+
     def thunk() -> TensorFrame:
         pieces: Dict[str, List[np.ndarray]] = {n: [] for n in fetch_names}
         part_sizes: List[int] = []
-        # device-resident columns: transferred once, sliced on device
-        dev_cols = {}
+        # device-resident columns when they fit; streamed blocks otherwise
+        feeders = {}
         for ph, col in binding.items():
             parent.column_block(col, None)  # rejects ragged/binary
-            dev_cols[ph] = parent.column_data(col).device()
+            feeders[ph] = _block_feeder(parent.column_data(col))
         for p in range(parent.num_partitions):
             lo, hi = parent.partition_bounds()[p]
             n = hi - lo
             if n == 0:
                 part_sizes.append(0)
                 continue
-            feed = {ph: dev_cols[ph][lo:hi] for ph in binding}
+            feed = {ph: feeders[ph](lo, hi) for ph in binding}
+            feed.update(const_feed)
             res = jit_fn(feed)
             out_n = None
             for name in fetch_names:
@@ -376,17 +436,31 @@ def map_rows(
                         v if isinstance(v, (bytes, bytearray)) else np.asarray(v)
                     )
         else:
+            from ..data import RaggedBuffer, gather_rows
+
             # bucket rows by the tuple of input cell shapes
             buckets: Dict[Tuple, List[int]] = {}
             for i in range(n):
                 key = tuple(col_data[ph].cell(i).shape for ph in binding)
                 buckets.setdefault(key, []).append(i)
+            # ragged 1-D columns pack once into (flat, offsets) so bucket
+            # stacking is a native gather instead of a Python stack loop
+            ragged_bufs: Dict[str, RaggedBuffer] = {}
+            for ph, cd in col_data.items():
+                if cd.dense is None and cd.cells[0].ndim == 1:
+                    ragged_bufs[ph] = RaggedBuffer.from_cells(cd.cells)
             vfn = _jitted_vmap(g)
-            for idxs in buckets.values():
-                feed = {
-                    ph: np.stack([col_data[ph].cell(i) for i in idxs])
-                    for ph in binding
-                }
+            for key, idxs in buckets.items():
+                idx_arr = np.asarray(idxs, dtype=np.int64)
+                feed = {}
+                for ph in binding:
+                    cd = col_data[ph]
+                    if cd.dense is not None:
+                        feed[ph] = gather_rows(cd.dense, idx_arr)
+                    elif ph in ragged_bufs:
+                        feed[ph] = ragged_bufs[ph].gather_pad(idx_arr)
+                    else:
+                        feed[ph] = np.stack([cd.cell(i) for i in idxs])
                 res = vfn(feed)
                 for name in fetch_names:
                     arr = np.asarray(res[name])
@@ -434,16 +508,16 @@ def reduce_blocks(fetches, dframe: TensorFrame):
     binding = validate_reduce_block_graph(g, dframe.schema)
     _ensure_precision(g, dframe.schema)
     jit_fn = _jitted(g)
-    dev_cols = {}
+    feeders = {}
     for f, col in binding.items():
         dframe.column_block(col, None)  # rejects ragged/binary
-        dev_cols[f] = dframe.column_data(col).device()
+        feeders[f] = _block_feeder(dframe.column_data(col))
     partials: List[Dict[str, Any]] = []
     for p in range(dframe.num_partitions):
         lo, hi = dframe.partition_bounds()[p]
         if hi - lo == 0:
             continue
-        feed = {f"{f}_input": dev_cols[f][lo:hi] for f in binding}
+        feed = {f"{f}_input": feeders[f](lo, hi) for f in binding}
         partials.append(jit_fn(feed))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
@@ -503,16 +577,16 @@ def reduce_rows(fetches, dframe: TensorFrame):
         merge_jit = jax.jit(merge)
         g._merge_cache = merge_jit
 
-    dev_cols = {}
+    feeders = {}
     for f, col in binding.items():
         dframe.column_block(col, None)  # rejects ragged/binary
-        dev_cols[f] = dframe.column_data(col).device()
+        feeders[f] = _block_feeder(dframe.column_data(col))
     partials: List[Dict[str, Any]] = []
     for p in range(dframe.num_partitions):
         lo, hi = dframe.partition_bounds()[p]
         if hi - lo == 0:
             continue
-        feed = {f: dev_cols[f][lo:hi] for f in binding}
+        feed = {f: feeders[f](lo, hi) for f in binding}
         partials.append(fold_block(feed))
     if not partials:
         raise ValueError("reduce_rows on an empty frame")
@@ -615,8 +689,10 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
 
         g._agg_scan_cache = scan_fn
 
+    from ..data import gather_rows
+
     sorted_feed = {
-        f: np.ascontiguousarray(np.asarray(dframe.column_block(col))[order])
+        f: gather_rows(np.asarray(dframe.column_block(col)), order)
         for f, col in binding.items()
     }
     scanned = scan_fn(sorted_feed, flags)

@@ -102,15 +102,20 @@ def validate_map_inputs(
     graph: CapturedGraph,
     schema: FrameInfo,
     block: bool,
+    constants: Optional[set] = None,
 ) -> Dict[str, str]:
     """Check every placeholder maps to a column with matching dtype and a
     compatible shape; returns placeholder name -> column name.
 
     ``block=True``: placeholder shape is a block shape (one dim higher than
-    the cell, ``Operations.scala:52-53``); ``block=False``: cell shape."""
+    the cell, ``Operations.scala:52-53``); ``block=False``: cell shape.
+    Placeholders named in ``constants`` are fed per call, not from columns,
+    and are skipped here."""
     binding: Dict[str, str] = {}
     missing: List[str] = []
     for ph in graph.placeholders.values():
+        if constants and ph.name in constants:
+            continue
         col = resolve_column(ph.name, graph.inputs_map, schema.names)
         if col is None:
             missing.append(ph.name)

@@ -82,13 +82,15 @@ class _ColumnData:
     engine repeatedly pays the host->device transfer once (the reference
     re-marshals every Session.run, ``TFDataOps.scala:27-59``)."""
 
-    __slots__ = ("dense", "cells", "is_binary", "_device_arr")
+    __slots__ = ("dense", "cells", "is_binary", "_device_arr", "_sharded_cache")
 
     def __init__(self, dense=None, cells=None, is_binary=False):
         self.dense: Optional[np.ndarray] = dense
         self.cells: Optional[List[Any]] = cells
         self.is_binary = is_binary
         self._device_arr = None
+        #: per-(mesh, split) device-sharded copies (parallel engine)
+        self._sharded_cache = None
 
     def device(self):
         """The dense column as a device-resident jax array (memoized)."""
@@ -137,7 +139,10 @@ def _build_column(name: str, data) -> Tuple[_ColumnData, ColumnInfo]:
         raise TypeError("internal type passed to _build_column")
     if isinstance(data, np.ndarray):
         st = for_numpy_dtype(data.dtype)
-        return _ColumnData(dense=np.ascontiguousarray(data)), ColumnInfo(
+        # copy: frames own their storage. Aliasing the caller's buffer would
+        # make later in-place mutation silently desync the memoized device
+        # copy (and any lazy results) from host data.
+        return _ColumnData(dense=np.array(data, order="C")), ColumnInfo(
             name, st, nesting=data.ndim - 1
         )
     data = list(data)
@@ -401,6 +406,7 @@ class TensorFrame:
         self._force()
         for cd in self._columns.values():
             cd._device_arr = None
+            cd._sharded_cache = None
         return self
 
     def slice_rows(self, lo: int, hi: int) -> "TensorFrame":

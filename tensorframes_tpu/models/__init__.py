@@ -8,6 +8,7 @@ from .mlp import (
     mlp_loss,
     softmax_cross_entropy,
 )
+from .kmeans import kmeans, assign_clusters
 
 __all__ = [
     "MLPClassifier",
@@ -16,4 +17,6 @@ __all__ = [
     "mlp_logits",
     "mlp_loss",
     "softmax_cross_entropy",
+    "kmeans",
+    "assign_clusters",
 ]

@@ -1,0 +1,128 @@
+// Native data-plane kernels: row <-> columnar packing hot loops.
+//
+// TPU-native analog of the reference's native tensor-buffer layer — the NIO
+// pack/unpack fast paths in
+// /root/reference/src/main/scala/org/tensorframes/impl/DataOps.scala:20-81
+// (convertFast0 / convertBackFast0) and the per-type appendRaw loops in
+// datatypes.scala:328-599, which the reference itself flags as its hot
+// loops (TFDataOps.scala:30-32). Here the payload work is pure byte
+// movement — padding ragged rows into dense device-feedable blocks and
+// back — so one size-generic implementation covers every scalar dtype.
+//
+// All offsets/lengths are int64 (matches Arrow large-list offsets and numpy
+// int64 index arrays). Buffers are caller-allocated; functions never
+// allocate. Single-threaded by design: callers batch at the column level
+// and the surrounding engine overlaps host packing with device compute.
+//
+// Build: g++ -O3 -shared -fPIC packer.cpp -o libtfspacker.so  (see
+// tensorframes_tpu/data/packer.py, which builds on demand and falls back to
+// numpy when no toolchain is present).
+
+#include <cstring>
+#include <cstdint>
+
+extern "C" {
+
+// Pack ragged rows (flat concatenated values + offsets, Arrow-style) into a
+// dense [n_rows, max_len] padded matrix. pad_elem points at one element's
+// byte pattern (NULL means zero fill).
+void tfs_pad_ragged(const char* flat,
+                    const int64_t* offsets,  // n_rows + 1 entries
+                    int64_t n_rows,
+                    int64_t max_len,
+                    int64_t elem_size,
+                    const char* pad_elem,
+                    char* out) {
+  const int64_t row_bytes = max_len * elem_size;
+  for (int64_t i = 0; i < n_rows; ++i) {
+    const int64_t len = offsets[i + 1] - offsets[i];
+    char* dst = out + i * row_bytes;
+    std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
+    char* pad_dst = dst + len * elem_size;
+    const int64_t pad_count = max_len - len;
+    if (pad_count <= 0) continue;
+    if (pad_elem == nullptr) {
+      std::memset(pad_dst, 0, pad_count * elem_size);
+    } else {
+      for (int64_t j = 0; j < pad_count; ++j) {
+        std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
+      }
+    }
+  }
+}
+
+// Inverse of tfs_pad_ragged: copy the first lengths[i] elements of each
+// padded row into a flat output buffer.
+void tfs_unpad_ragged(const char* padded,
+                      const int64_t* lengths,  // n_rows entries
+                      int64_t n_rows,
+                      int64_t max_len,
+                      int64_t elem_size,
+                      char* out_flat) {
+  const int64_t row_bytes = max_len * elem_size;
+  int64_t off = 0;
+  for (int64_t i = 0; i < n_rows; ++i) {
+    const int64_t len = lengths[i];
+    std::memcpy(out_flat + off * elem_size, padded + i * row_bytes,
+                len * elem_size);
+    off += len;
+  }
+}
+
+// Gather fixed-width rows by index: out[k] = src[idx[k]]. The sort/shuffle
+// step of keyed aggregation and shard re-layout.
+void tfs_gather_rows(const char* src,
+                     int64_t row_bytes,
+                     const int64_t* idx,
+                     int64_t n_idx,
+                     char* out) {
+  for (int64_t k = 0; k < n_idx; ++k) {
+    std::memcpy(out + k * row_bytes, src + idx[k] * row_bytes, row_bytes);
+  }
+}
+
+// Gather ragged rows by index into a dense padded matrix: the bucketing
+// step of map_rows (rows of one shape bucket stacked for vmap).
+void tfs_gather_ragged_pad(const char* flat,
+                           const int64_t* offsets,
+                           const int64_t* idx,
+                           int64_t n_idx,
+                           int64_t max_len,
+                           int64_t elem_size,
+                           const char* pad_elem,
+                           char* out) {
+  const int64_t row_bytes = max_len * elem_size;
+  for (int64_t k = 0; k < n_idx; ++k) {
+    const int64_t i = idx[k];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    char* dst = out + k * row_bytes;
+    std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
+    const int64_t pad_count = max_len - len;
+    if (pad_count <= 0) continue;
+    char* pad_dst = dst + len * elem_size;
+    if (pad_elem == nullptr) {
+      std::memset(pad_dst, 0, pad_count * elem_size);
+    } else {
+      for (int64_t j = 0; j < pad_count; ++j) {
+        std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
+      }
+    }
+  }
+}
+
+// Scatter fixed-width rows by index: out[idx[k]] = src[k]. Inverse of
+// tfs_gather_rows; used to restore original row order after bucketed
+// execution.
+void tfs_scatter_rows(const char* src,
+                      int64_t row_bytes,
+                      const int64_t* idx,
+                      int64_t n_idx,
+                      char* out) {
+  for (int64_t k = 0; k < n_idx; ++k) {
+    std::memcpy(out + idx[k] * row_bytes, src + k * row_bytes, row_bytes);
+  }
+}
+
+int64_t tfs_packer_abi_version() { return 1; }
+
+}  // extern "C"

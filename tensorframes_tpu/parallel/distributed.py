@@ -14,9 +14,9 @@ broadcast of graph bytes    jit-compiled program, resident per device
  funnel (``:524``,          + an on-device fold of the user's merge program —
  ``reducePair:732-750``)    no host round-trip, executed inside the same XLA
                             program as the local reduction
-Spark shuffle + UDAF        two-phase aggregation: per-shard local aggregate,
- (``:547-592``)             then a merge aggregate over the concatenated
-                            partials (classic partial-agg/final-agg)
+Spark shuffle + UDAF        global key sort + sharded segmented associative
+ (``:547-592``)             scan + small boundary-group merge (partial/final
+                            aggregation)
 ==========================  =================================================
 
 Row counts not divisible by the mesh size are handled with a main+tail
@@ -25,6 +25,11 @@ block, and reduces merge the tail partial through the same pair-merge
 program. Partition boundaries are not semantically observable (same contract
 as Spark partitions in the reference), so this is behavior-preserving.
 
+Compilation and transfer are both amortized: every jitted program (sharded
+main, tail fold, pair merge) is memoized on the CapturedGraph, and
+device-sharded copies of immutable columns are memoized per (mesh, split) —
+iterative algorithms pay tracing and host->device movement once.
+
 Multi-host: this module only speaks ``jax.devices()`` — under
 ``jax.distributed.initialize`` the same code sees all hosts' addressable
 devices and the collectives ride DCN across hosts; no code change needed.
@@ -32,7 +37,7 @@ devices and the collectives ride DCN across hosts; no code change needed.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -53,9 +58,8 @@ from ..engine.validation import (
     validate_reduce_row_graph,
 )
 from ..frame import GroupedFrame, TensorFrame
-from ..frame.table import _ColumnData
 from ..schema import FrameInfo, Shape, Unknown
-from ..utils import get_logger
+from ..utils import get_config, get_logger
 from .mesh import DATA_AXIS, default_mesh
 
 __all__ = ["map_blocks", "reduce_blocks", "reduce_rows", "aggregate"]
@@ -77,40 +81,94 @@ def _dp_spec():
     return P(DATA_AXIS)
 
 
-def _shard_mapped(g, mesh, body, out_sharded: bool, kind: str):
-    """Wrap ``body`` (a per-shard dict->dict function) in jit(shard_map).
-    All inputs and outputs are row-sharded over ``dp`` (a spec shorter than
-    the array rank leaves trailing dims unsharded).
+def _split(n: int, ndev: int):
+    main = (n // ndev) * ndev
+    return main, n - main
 
-    The jitted wrapper is memoized on the CapturedGraph per (mesh, kind) so
-    repeated ops reuse one compiled sharded program, matching the local
-    engine's per-graph jit cache."""
-    import jax
-    from jax.sharding import PartitionSpec as P
 
+# ---------------------------------------------------------------------------
+# per-graph program + feed caches
+# ---------------------------------------------------------------------------
+
+
+def _cached_program(g, key, build: Callable[[], Any]):
+    """Memoize a compiled program on the CapturedGraph (the distributed
+    analog of the local engine's ``g._jit_cache``)."""
     cache = getattr(g, "_shard_cache", None)
     if cache is None:
         cache = {}
         g._shard_cache = cache
-    key = (mesh, kind, out_sharded)
     if key not in cache:
-        sm = jax.shard_map(
-            body,
-            mesh=mesh,
-            in_specs=({ph: _dp_spec() for ph in g.placeholders},),
-            out_specs=_dp_spec() if out_sharded else P(),
-        )
-        cache[key] = jax.jit(sm)
+        cache[key] = build()
     return cache[key]
 
 
-def _feed_arrays(df: TensorFrame, binding: Dict[str, str]) -> Dict[str, np.ndarray]:
-    return {ph: np.asarray(df.column_block(col)) for ph, col in binding.items()}
+def _shard_mapped(g, mesh, body, kind: str, const_names=()):
+    """jit(shard_map(body)) with column inputs/outputs row-sharded over
+    ``dp`` and ``const_names`` replicated; memoized per (mesh, kind)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    const_names = tuple(sorted(const_names))
+
+    def build():
+        return jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(
+                    {
+                        ph: (P() if ph in const_names else _dp_spec())
+                        for ph in g.placeholders
+                    },
+                ),
+                out_specs=_dp_spec(),
+            )
+        )
+
+    return _cached_program(g, (mesh, kind, const_names), build)
 
 
-def _split(n: int, ndev: int):
-    main = (n // ndev) * ndev
-    return main, n - main
+def _sharded_main_feed(
+    df: TensorFrame, binding: Dict[str, str], mesh, main: int, key_fmt=str
+) -> Dict[str, Any]:
+    """Feed dict for the sharded main region.
+
+    Columns within the device-cache budget are device_put once with the
+    row-sharded NamedSharding and memoized per (mesh, main) on the column;
+    larger columns stream as host slices (re-transferred per call, HBM
+    bounded)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    thr = get_config().device_cache_bytes
+    out: Dict[str, Any] = {}
+    for ph, col in binding.items():
+        cd = df.column_data(col)
+        arr = cd.dense
+        if arr.nbytes <= thr:
+            cache = cd._sharded_cache
+            if cache is None:
+                cache = {}
+                cd._sharded_cache = cache
+            ckey = (mesh, main)
+            if ckey not in cache:
+                cache[ckey] = jax.device_put(
+                    arr[:main], NamedSharding(mesh, _dp_spec())
+                )
+            out[key_fmt(ph)] = cache[ckey]
+        else:
+            out[key_fmt(ph)] = arr[:main]
+    return out
+
+
+def _tail_feed(
+    df: TensorFrame, binding: Dict[str, str], main: int, key_fmt=str
+) -> Dict[str, Any]:
+    return {
+        key_fmt(ph): df.column_data(col).dense[main:]
+        for ph, col in binding.items()
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -124,14 +182,21 @@ def map_blocks(
     mesh=None,
     trim: bool = False,
     feed_dict: Optional[Dict[str, str]] = None,
+    constants: Optional[Dict[str, Any]] = None,
 ) -> TensorFrame:
     """``map_blocks`` with one row shard per chip: a single ``shard_map``
     program executes the captured graph on every chip's shard concurrently
     (the distributed analog of the reference's per-partition tasks,
-    ``DebugRowOps.scala:377-391``)."""
+    ``DebugRowOps.scala:377-391``). ``constants`` are replicated per-call
+    inputs (see the local engine docstring)."""
     mesh = _mesh_or_default(mesh)
-    g = _as_graph(fetches, dframe, cell_inputs=False, feed_dict=feed_dict)
-    binding = validate_map_inputs(g, dframe.schema, block=True)
+    g = _as_graph(
+        fetches, dframe, cell_inputs=False, feed_dict=feed_dict,
+        constants=constants,
+    )
+    binding = validate_map_inputs(
+        g, dframe.schema, block=True, constants=set(constants or ())
+    )
     _ensure_precision(g, dframe.schema)
     input_shapes = {
         ph: dframe.schema[col].block_shape.with_lead(Unknown)
@@ -157,32 +222,42 @@ def map_blocks(
     )
     ndev = _dp_size(mesh)
     parent = dframe
+    const_feed = {ph: np.asarray(v) for ph, v in (constants or {}).items()}
 
     def thunk() -> TensorFrame:
-        arrays = _feed_arrays(parent, binding)
+        from ..frame.table import _ColumnData
+
+        for col in binding.values():
+            parent.column_block(col, None)  # rejects ragged/binary
         n = parent.num_rows
         main, tail = _split(n, ndev)
         pieces: Dict[str, List[np.ndarray]] = {f: [] for f in fetch_names}
+
+        def check_rows(arr, expect, f):
+            if not trim and arr.shape[0] != expect:
+                raise ValueError(
+                    f"map_blocks output {f!r} changed the row count; "
+                    f"only trimmed maps may do that"
+                )
+
         if main:
-            prog = _shard_mapped(g, mesh, g.fn, out_sharded=True, kind="map")
-            res = prog({ph: a[:main] for ph, a in arrays.items()})
+            prog = _shard_mapped(
+                g, mesh, g.fn, kind="map", const_names=const_feed
+            )
+            res = prog(
+                _sharded_main_feed(parent, binding, mesh, main) | const_feed
+            )
             for f in fetch_names:
                 arr = np.asarray(res[f])
-                if not trim and arr.shape[0] != main:
-                    raise ValueError(
-                        f"map_blocks output {f!r} changed the row count; "
-                        f"only trimmed maps may do that"
-                    )
+                check_rows(arr, main, f)
                 pieces[f].append(arr)
         if tail:
-            res = _jitted(g)({ph: a[main:] for ph, a in arrays.items()})
+            res = _jitted(g)(
+                _tail_feed(parent, binding, main) | const_feed
+            )
             for f in fetch_names:
                 arr = np.asarray(res[f])
-                if not trim and arr.shape[0] != tail:
-                    raise ValueError(
-                        f"map_blocks output {f!r} changed the row count; "
-                        f"only trimmed maps may do that"
-                    )
+                check_rows(arr, tail, f)
                 pieces[f].append(arr)
         cols: Dict[str, _ColumnData] = {}
         for f in fetch_names:
@@ -206,29 +281,21 @@ def map_blocks(
 # ---------------------------------------------------------------------------
 
 
-def _pair_merge_blocks(g, acc, part):
-    """Merge two block-reduce partials through the graph (host-driven,
-    used only for the tail remainder)."""
-    import jax.numpy as jnp
-
-    feed = {
-        f"{f}_input": jnp.stack([acc[f], part[f]]) for f in g.fetch_names
-    }
-    return _jitted(g)(feed)
-
-
 def reduce_blocks(fetches, dframe: TensorFrame, mesh=None):
     """Distributed block reduce: each chip reduces its shard, partials are
     ``all_gather``-ed over the ``dp`` axis (ICI), and the user's own merge
     program folds them — all in one compiled program. This replaces the
     reference's executors→driver funnel (``DebugRowOps.scala:503-526``)
     with a collective."""
+    import jax
     import jax.numpy as jnp
     from jax import lax
 
     mesh = _mesh_or_default(mesh)
     g = _as_graph(fetches, dframe, cell_inputs=False)
     binding = validate_reduce_block_graph(g, dframe.schema)
+    for col in binding.values():
+        dframe.column_block(col, None)
     _ensure_precision(g, dframe.schema)
     fetch_names = list(g.fetch_names)
 
@@ -253,25 +320,35 @@ def reduce_blocks(fetches, dframe: TensorFrame, mesh=None):
         # emit as a sharded [1, ...] row per shard; identical on every shard
         return {f: out[f][None] for f in fetch_names}
 
-    arrays = {
-        f"{f}_input": np.asarray(dframe.column_block(col))
-        for f, col in binding.items()
-    }
     n = dframe.num_rows
     if n == 0:
         raise ValueError("reduce_blocks on an empty frame")
     ndev = _dp_size(mesh)
     main, tail = _split(n, ndev)
+    fmt = "{}_input".format
     acc = None
     if main:
-        sharded = _shard_mapped(
-            g, mesh, prog, out_sharded=True, kind="reduce_blocks"
-        )
-        res = sharded({ph: a[:main] for ph, a in arrays.items()})
+        sharded = _shard_mapped(g, mesh, prog, kind="reduce_blocks")
+        res = sharded(_sharded_main_feed(dframe, binding, mesh, main, fmt))
         acc = {f: res[f][0] for f in fetch_names}
     if tail:
-        part = _jitted(g)({ph: a[main:] for ph, a in arrays.items()})
-        acc = part if acc is None else _pair_merge_blocks(g, acc, part)
+        part = _jitted(g)(_tail_feed(dframe, binding, main, fmt))
+        if acc is None:
+            acc = part
+        else:
+            merge = _cached_program(
+                g,
+                "pair_merge",
+                lambda: jax.jit(
+                    lambda a, b: g.fn(
+                        {
+                            f"{f}_input": jnp.stack([a[f], b[f]])
+                            for f in fetch_names
+                        }
+                    )
+                ),
+            )
+            acc = merge(acc, part)
     return _unpack_reduce_result(acc, fetch_names)
 
 
@@ -279,12 +356,15 @@ def reduce_rows(fetches, dframe: TensorFrame, mesh=None):
     """Distributed pairwise row reduce: per-shard ``lax.scan`` fold, then the
     same all_gather + on-device merge fold as :func:`reduce_blocks`
     (reference ``DebugRowOps.scala:479-501``)."""
-    import jax.numpy as jnp
+    import jax
     from jax import lax
+    from jax.sharding import PartitionSpec as P
 
     mesh = _mesh_or_default(mesh)
     g = _as_graph(fetches, dframe, cell_inputs=True)
     binding = validate_reduce_row_graph(g, dframe.schema)
+    for col in binding.values():
+        dframe.column_block(col, None)
     _ensure_precision(g, dframe.schema)
     fetch_names = list(g.fetch_names)
 
@@ -319,43 +399,38 @@ def reduce_rows(fetches, dframe: TensorFrame, mesh=None):
         out, _ = lax.scan(body, init, rest)
         return {f: out[f][None] for f in fetch_names}
 
-    arrays = {
-        f: np.asarray(dframe.column_block(col)) for f, col in binding.items()
-    }
     n = dframe.num_rows
     if n == 0:
         raise ValueError("reduce_rows on an empty frame")
     ndev = _dp_size(mesh)
     main, tail = _split(n, ndev)
-    import jax
-
     acc = None
     if main:
-        # placeholders of this graph are f_1/f_2, but the sharded program is
-        # fed whole columns keyed by fetch name
-        from jax.sharding import PartitionSpec as P
-
-        cache = getattr(g, "_shard_cache", None)
-        if cache is None:
-            cache = {}
-            g._shard_cache = cache
-        key = (mesh, "reduce_rows", True)
-        if key not in cache:
-            cache[key] = jax.jit(
+        # the sharded program is fed whole columns keyed by fetch name
+        sm = _cached_program(
+            g,
+            (mesh, "reduce_rows"),
+            lambda: jax.jit(
                 jax.shard_map(
                     prog,
                     mesh=mesh,
                     in_specs=({f: P(DATA_AXIS) for f in fetch_names},),
                     out_specs=P(DATA_AXIS),
                 )
-            )
-        sm = cache[key]
-        res = sm({f: a[:main] for f, a in arrays.items()})
+            ),
+        )
+        res = sm(_sharded_main_feed(dframe, binding, mesh, main))
         acc = {f: res[f][0] for f in fetch_names}
     if tail:
-        tail_feed = {f: a[main:] for f, a in arrays.items()}
-        part = jax.jit(local_fold)(tail_feed)
-        acc = part if acc is None else jax.jit(merge)(acc, part)
+        fold = _cached_program(
+            g, "tail_fold", lambda: jax.jit(local_fold)
+        )
+        part = fold(_tail_feed(dframe, binding, main))
+        if acc is None:
+            acc = part
+        else:
+            pm = _cached_program(g, "pair_merge", lambda: jax.jit(merge))
+            acc = pm(acc, part)
     return _unpack_reduce_result(acc, fetch_names)
 
 
@@ -453,38 +528,39 @@ def aggregate(
         scanned, _ = lax.associative_scan(combine, (per_row, flags_), axis=0)
         return scanned
 
-    cache = getattr(g, "_shard_cache", None)
-    if cache is None:
-        cache = {}
-        g._shard_cache = cache
-    key_ = (mesh, "aggregate", True)
-    if key_ not in cache:
-        cache[key_] = jax.jit(
-            jax.shard_map(
-                scan_body,
-                mesh=mesh,
-                in_specs=(
-                    {f: P(DATA_AXIS) for f in fetch_names},
-                    P(DATA_AXIS),
-                ),
-                out_specs=P(DATA_AXIS),
-            )
-        )
-    sharded_scan = cache[key_]
+    from ..data import gather_rows
 
     sorted_feed = {
-        f: np.ascontiguousarray(np.asarray(df.column_block(col))[order])
+        f: gather_rows(np.asarray(df.column_block(col)), order)
         for f, col in binding.items()
     }
     pieces: Dict[str, List[np.ndarray]] = {f: [] for f in fetch_names}
     if main:
+        sharded_scan = _cached_program(
+            g,
+            (mesh, "aggregate"),
+            lambda: jax.jit(
+                jax.shard_map(
+                    scan_body,
+                    mesh=mesh,
+                    in_specs=(
+                        {f: P(DATA_AXIS) for f in fetch_names},
+                        P(DATA_AXIS),
+                    ),
+                    out_specs=P(DATA_AXIS),
+                )
+            ),
+        )
         scanned = sharded_scan(
             {f: a[:main] for f, a in sorted_feed.items()}, flags[:main]
         )
         for f in fetch_names:
             pieces[f].append(np.asarray(scanned[f]))
     if tail:
-        scanned = jax.jit(scan_body)(
+        tail_scan = _cached_program(
+            g, "aggregate_tail", lambda: jax.jit(scan_body)
+        )
+        scanned = tail_scan(
             {f: a[main:] for f, a in sorted_feed.items()}, flags[main:]
         )
         for f in fetch_names:

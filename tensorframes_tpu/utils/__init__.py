@@ -1,6 +1,14 @@
 """Utilities: logging, config, profiling hooks."""
 
-from .config import ensure_x64
+from .config import Config, get_config, set_config, ensure_x64
 from .logging import get_logger
+from . import profiling
 
-__all__ = ["ensure_x64", "get_logger"]
+__all__ = [
+    "Config",
+    "get_config",
+    "set_config",
+    "ensure_x64",
+    "get_logger",
+    "profiling",
+]

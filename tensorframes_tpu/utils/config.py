@@ -1,18 +1,43 @@
-"""Precision / device configuration helpers.
+"""Precision / device configuration.
 
 The reference has no config system at all (SURVEY §5: per-op configuration is
 the ``ShapeDescription`` hint object; the UDAF buffer size is a hard-coded
-``10``, ``DebugRowOps.scala:573``). Engine knobs will be added here as they
-gain consumers; today the only global switch is 64-bit precision.
+``10``, ``DebugRowOps.scala:573``). Knobs live here only once something
+consumes them.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 
-__all__ = ["ensure_x64"]
+__all__ = ["Config", "get_config", "set_config", "ensure_x64"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    #: columns whose host size exceeds this are fed to the engine one
+    #: partition block at a time instead of being memoized whole on device —
+    #: bounds HBM use for frames larger than device memory
+    #: (consumed by engine/ops.py and parallel/distributed.py).
+    device_cache_bytes: int = 4 << 30
+
 
 _lock = threading.Lock()
+_config = Config()
+
+
+def get_config() -> Config:
+    return _config
+
+
+def set_config(**kwargs) -> Config:
+    global _config
+    with _lock:
+        _config = dataclasses.replace(_config, **kwargs)
+    return _config
+
+
 _x64_done = False
 
 

@@ -1,0 +1,76 @@
+"""Profiling & timing hooks.
+
+The reference ships none (SURVEY §5: timing is manual prints in ``ignore``d
+suites). Here the jax profiler is first-class: ``trace()`` captures a
+Perfetto/TensorBoard-compatible device trace; ``Timer`` wraps wall-clock
+sections with device synchronization so numbers mean what they say.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["trace", "Timer", "block_until_ready"]
+
+
+def block_until_ready(tree) -> None:
+    """Synchronize: wait for every array in a pytree (async dispatch means
+    wall-clock without this measures dispatch, not compute)."""
+    import jax
+
+    jax.block_until_ready(tree)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, host_tracer_level: int = 2):
+    """Capture a device+host trace viewable in Perfetto / TensorBoard::
+
+        with tft.utils.profiling.trace("/tmp/trace"):
+            df2.collect()
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir, host_tracer_level=host_tracer_level)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timer:
+    """Accumulating section timer with device sync.
+
+    >>> t = Timer()
+    >>> with t.section("score"):
+    ...     out = engine_call()
+    >>> t.report()
+    """
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def section(self, name: str, sync=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                block_until_ready(sync)
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> str:
+        lines = []
+        for name in sorted(self.totals, key=self.totals.get, reverse=True):
+            n = self.counts[name]
+            tot = self.totals[name]
+            lines.append(
+                f"{name}: {tot * 1e3:.2f} ms total, {n} calls, "
+                f"{tot / n * 1e3:.3f} ms/call"
+            )
+        return "\n".join(lines)

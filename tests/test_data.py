@@ -1,0 +1,184 @@
+"""Data-plane tests: native packer vs numpy fallback equivalence (analog of
+the reference's conversion perf/correctness suites,
+`perf/ConvertPerformanceSuite.scala`, `DebugRowOpsSuite.scala`)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.data import (
+    RaggedBuffer,
+    gather_ragged_pad,
+    gather_rows,
+    native_available,
+    pad_ragged,
+    scatter_rows,
+    unpad_ragged,
+)
+from tensorframes_tpu.data import packer as packer_mod
+
+
+def _np_pad(flat, offsets, max_len, pad_value):
+    n = len(offsets) - 1
+    out = np.full((n, max_len), pad_value, dtype=flat.dtype)
+    for i in range(n):
+        row = flat[offsets[i] : offsets[i + 1]]
+        out[i, : len(row)] = row
+    return out
+
+
+@pytest.fixture(params=["float64", "float32", "int32", "int64", "uint8"])
+def dtype(request):
+    return np.dtype(request.param)
+
+
+def make_ragged(rng, dtype, n=50, max_len=17):
+    lens = rng.integers(0, max_len + 1, n)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    flat = (rng.normal(size=offsets[-1]) * 10).astype(dtype)
+    return flat, offsets
+
+
+def test_native_builds():
+    # the toolchain is present in this image; the native path must be live
+    assert native_available()
+
+
+def test_pad_matches_fallback(rng, dtype):
+    flat, offsets = make_ragged(rng, dtype)
+    got = pad_ragged(flat, offsets, pad_value=3)
+    want = _np_pad(flat, offsets, int(np.diff(offsets).max()), 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pad_explicit_maxlen(rng):
+    flat, offsets = make_ragged(rng, np.dtype("float32"))
+    got = pad_ragged(flat, offsets, max_len=40, pad_value=-1)
+    assert got.shape[1] == 40
+    with pytest.raises(ValueError, match="max_len"):
+        pad_ragged(flat, offsets, max_len=1)
+
+
+def test_unpad_roundtrip(rng, dtype):
+    flat, offsets = make_ragged(rng, dtype)
+    padded = pad_ragged(flat, offsets)
+    back = unpad_ragged(padded, np.diff(offsets))
+    np.testing.assert_array_equal(back, flat)
+
+
+def test_gather_rows(rng, dtype):
+    src = (rng.normal(size=(30, 4)) * 10).astype(dtype)
+    idx = rng.permutation(30)[:12]
+    np.testing.assert_array_equal(gather_rows(src, idx), src[idx])
+
+
+def test_gather_rows_3d(rng):
+    src = rng.normal(size=(10, 3, 2))
+    idx = np.array([4, 1, 9])
+    np.testing.assert_array_equal(gather_rows(src, idx), src[idx])
+
+
+def test_scatter_rows_inverts_gather(rng):
+    src = rng.normal(size=(20, 5)).astype(np.float32)
+    perm = rng.permutation(20)
+    gathered = gather_rows(src, perm)
+    restored = scatter_rows(gathered, perm, 20)
+    np.testing.assert_array_equal(restored, src)
+
+
+def test_gather_ragged_pad(rng, dtype):
+    flat, offsets = make_ragged(rng, dtype)
+    idx = np.array([3, 0, 7, 7], dtype=np.int64)
+    lens = np.diff(offsets)
+    ml = int(lens[idx].max())
+    got = gather_ragged_pad(flat, offsets, idx, ml, pad_value=0)
+    want = _np_pad(flat, offsets, int(lens.max()), 0)[idx][:, :ml]
+    np.testing.assert_array_equal(got, want)
+
+
+class TestRaggedBuffer:
+    def test_from_cells_roundtrip(self, rng):
+        cells = [rng.normal(size=rng.integers(0, 6)) for _ in range(20)]
+        rb = RaggedBuffer.from_cells(cells)
+        assert rb.num_rows == 20
+        for i, c in enumerate(cells):
+            np.testing.assert_array_equal(rb.cell(i), c)
+
+    def test_pad_and_back(self, rng):
+        cells = [rng.normal(size=k) for k in (3, 1, 4, 1)]
+        rb = RaggedBuffer.from_cells(cells)
+        padded = rb.pad()
+        assert padded.shape == (4, 4)
+        rb2 = RaggedBuffer.from_padded(padded, rb.lengths)
+        np.testing.assert_array_equal(rb2.flat, rb.flat)
+
+    def test_gather_pad_equal_bucket(self, rng):
+        cells = [rng.normal(size=3) for _ in range(5)] + [rng.normal(size=7)]
+        rb = RaggedBuffer.from_cells(cells)
+        idx = np.array([0, 2, 4])
+        got = rb.gather_pad(idx)
+        assert got.shape == (3, 3)
+        np.testing.assert_array_equal(got[1], cells[2])
+
+    def test_invalid_offsets(self):
+        with pytest.raises(ValueError):
+            RaggedBuffer(np.arange(3.0), np.array([1, 3], dtype=np.int64))
+
+
+class TestBoundsChecks:
+    """The native path must never memcpy out of bounds (these inputs
+    previously corrupted the heap)."""
+
+    def test_gather_pad_maxlen_too_small(self):
+        rb = RaggedBuffer.from_cells([np.arange(8.0), np.arange(2.0)])
+        with pytest.raises(ValueError, match="max_len"):
+            rb.gather_pad(np.array([1, 0]), max_len=3)
+
+    def test_unpad_lengths_too_large(self, rng):
+        padded = rng.normal(size=(1, 2))
+        with pytest.raises(ValueError, match="lengths"):
+            unpad_ragged(np.ascontiguousarray(padded), np.array([5]))
+
+    def test_unpad_negative_length(self, rng):
+        padded = np.ascontiguousarray(rng.normal(size=(2, 3)))
+        with pytest.raises(ValueError, match="lengths"):
+            unpad_ragged(padded, np.array([1, -1]))
+
+    def test_gather_rows_oob(self, rng):
+        src = rng.normal(size=(4, 2))
+        with pytest.raises(IndexError):
+            gather_rows(src, np.array([0, 7]))
+        with pytest.raises(IndexError):
+            gather_rows(src, np.array([-1]))
+
+    def test_scatter_rows_oob(self, rng):
+        src = rng.normal(size=(2, 2))
+        with pytest.raises(IndexError):
+            scatter_rows(src, np.array([0, 9]), 4)
+
+    def test_gather_ragged_oob_index(self, rng):
+        flat, offsets = make_ragged(rng, np.dtype("float64"), n=5)
+        with pytest.raises(IndexError):
+            gather_ragged_pad(flat, offsets, np.array([9]), 4)
+
+
+def test_frame_copies_on_ingest():
+    """Mutating the caller's array after frame construction must not change
+    engine results (columns own their storage)."""
+    import tensorframes_tpu as tft
+
+    x = np.arange(4.0)
+    df = tft.TensorFrame.from_columns({"x": x})
+    first = [r.z for r in tft.map_blocks(lambda x: {"z": x * 1.0}, df).collect()]
+    x[:] = 100.0
+    second = [r.z for r in tft.map_blocks(lambda x: {"z": x * 1.0}, df).collect()]
+    assert first == second == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_fallback_matches_native(rng, monkeypatch):
+    """Force the numpy fallback and check it agrees with the native path."""
+    flat, offsets = make_ragged(rng, np.dtype("float64"))
+    native = pad_ragged(flat, offsets, pad_value=9)
+    monkeypatch.setattr(packer_mod, "_load", lambda: None)
+    fallback = pad_ragged(flat, offsets, pad_value=9)
+    np.testing.assert_array_equal(native, fallback)

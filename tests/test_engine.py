@@ -142,6 +142,30 @@ class TestMapBlocks:
         )
         assert [r.z for r in df2.collect()] == [1.0, 2.0, 3.0, 4.0]
 
+    def test_constants_feed(self):
+        # constants are row-independent parameters (e.g. centroids/weights)
+        df = scalar_df(4)
+        w = np.array([10.0, 100.0])
+        df2 = tft.map_blocks(
+            lambda x, w: {"z": x[:, None] * w[None, :]}, df, constants={"w": w}
+        )
+        rows = df2.collect()
+        assert rows[2].z.tolist() == [20.0, 200.0]
+
+    def test_constants_reuse_one_graph(self):
+        # same fn object + same shapes -> one CapturedGraph across calls
+        from tensorframes_tpu.engine.ops import _callable_graphs
+
+        df = scalar_df(4)
+
+        def fn(x, c):
+            return {"z": x * c}
+
+        tft.map_blocks(fn, df, constants={"c": np.array(2.0)}).cache()
+        g1 = _callable_graphs[fn]
+        tft.map_blocks(fn, df, constants={"c": np.array(5.0)}).cache()
+        assert _callable_graphs[fn] is g1 and len(g1) == 1
+
     def test_lazy_chaining(self):
         df = scalar_df(4)
         df2 = tft.map_blocks(lambda x: {"z": x + 1.0}, df)

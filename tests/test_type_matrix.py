@@ -1,0 +1,93 @@
+"""Dtype-matrix replication suite.
+
+Analog of the reference's abstract ``CommonOperationsSuite[T]`` instantiated
+per dtype (`/root/reference/src/test/scala/org/tensorframes/type_suites.scala:8-213`):
+identity/monoid operations across Int/Double/Float/Long, here parametrized
+over the same four scalar types for every op family."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+
+DTYPES = [np.float64, np.float32, np.int32, np.int64]
+
+
+def ids(dt):
+    return np.dtype(dt).name
+
+
+@pytest.fixture(params=DTYPES, ids=ids)
+def dtype(request):
+    return request.param
+
+
+def make_df(dtype, n=6, parts=2):
+    return tft.TensorFrame.from_columns(
+        {"x": np.arange(1, n + 1, dtype=dtype)}, num_partitions=parts
+    )
+
+
+class TestIdentity:
+    # reference BasicIdentityTests (type_suites.scala:8-95)
+
+    def test_scalar_identity(self, dtype):
+        df = make_df(dtype)
+        out = tft.map_blocks(lambda x: {"z": x}, df).collect()
+        assert [r.z for r in out] == [r.x for r in out]
+        assert out[0].z == dtype(1)
+
+    def test_vector_identity(self, dtype):
+        df = tft.TensorFrame.from_columns(
+            {"y": np.arange(8, dtype=dtype).reshape(4, 2)}
+        ).analyze()
+        out = tft.map_blocks(lambda y: {"z": y}, df).collect()
+        assert out[1].z.tolist() == out[1].y.tolist()
+
+    def test_dtype_preserved(self, dtype):
+        df = make_df(dtype)
+        df2 = tft.map_blocks(lambda x: {"z": x + x}, df)
+        assert df2.schema["z"].scalar_type.name == np.dtype(dtype).name
+        block = df2.cache().column_block("z")
+        assert block.dtype == np.dtype(dtype)
+
+
+class TestMonoid:
+    # reference BasicMonoidTests (type_suites.scala:97-187)
+
+    def test_reduce_blocks_sum(self, dtype):
+        df = make_df(dtype)
+        out = tft.reduce_blocks(
+            lambda x_input: {"x": x_input.sum(axis=0)}, df
+        )
+        assert out == dtype(21)
+
+    def test_reduce_rows_sum(self, dtype):
+        df = make_df(dtype)
+        out = tft.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, df)
+        assert out == dtype(21)
+
+    def test_reduce_blocks_min(self, dtype):
+        df = make_df(dtype)
+        out = tft.reduce_blocks(
+            lambda x_input: {"x": x_input.min(axis=0)}, df
+        )
+        assert out == dtype(1)
+
+    def test_aggregate_sum(self, dtype):
+        df = tft.TensorFrame.from_columns(
+            {
+                "k": np.array([0, 0, 1, 1], dtype=np.int64),
+                "x": np.array([1, 2, 3, 4], dtype=dtype),
+            }
+        )
+        out = tft.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)}, df.group_by("k")
+        )
+        rows = sorted(out.collect(), key=lambda r: r.k)
+        assert [r.x for r in rows] == [dtype(3), dtype(7)]
+
+    def test_map_rows_identity(self, dtype):
+        df = make_df(dtype, parts=1)
+        out = tft.map_rows(lambda x: {"z": x * dtype(2)}, df).collect()
+        assert [r.z for r in out] == [dtype(2 * i) for i in range(1, 7)]
