@@ -1,0 +1,197 @@
+"""The five BASELINE.md benchmark configs.
+
+Each function runs one config and returns a result dict; ``run_all.py``
+prints them as JSON lines. ``bench.py`` at the repo root runs config 3 (the
+driver's headline metric). Hardware note: numbers depend on the attached
+backend — real TPU via the default platform, or CPU when forced.
+
+| # | config | reference provenance |
+|---|--------|----------------------|
+| 1 | README scalar add-3 map_blocks            | README.md:60-88 |
+| 2 | README vector reduce_sum/min on [?,2]     | README.md:91-122 |
+| 3 | MNIST LR scoring via map_blocks           | core.py:41-55 (frozen graphs) |
+| 4 | image-embedding map_rows over binary rows | read_image.py:147-167 |
+| 5 | distributed SGD: map_blocks(grad) + reduce_blocks(sum) | DebugRowOps.scala:290-526 |
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+
+def _timeit(fn, iters=5, warmup=1):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def config1_add3(n_rows: int = 1_000_000) -> Dict:
+    """Scalar add-3 map_blocks (README example 1, scaled up)."""
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.capture import functions as F
+
+    df = tft.TensorFrame.from_columns(
+        {"x": np.arange(n_rows, dtype=np.float64)}
+    )
+    with tft.graph():
+        x = tft.block(df, "x")
+        g = tft.build_graph((x + 3.0).named("z"))
+
+    def run():
+        return tft.map_blocks(g, df).cache().column_block("z")
+
+    dt = _timeit(run)
+    assert float(run()[0]) == 3.0
+    return {
+        "metric": "config1_add3_rows_per_sec",
+        "value": round(n_rows / dt, 1),
+        "unit": "rows/s",
+        "seconds_per_pass": round(dt, 4),
+    }
+
+
+def config2_vector_reduce(n_rows: int = 1_000_000) -> Dict:
+    """Vector reduce_sum + reduce_min on [?, 2] doubles (README example 2)."""
+    import tensorframes_tpu as tft
+
+    y = np.stack(
+        [np.arange(n_rows, dtype=np.float64), -np.arange(n_rows, dtype=np.float64)],
+        axis=1,
+    )
+    df = tft.TensorFrame.from_columns({"y": y, "z": y.copy()}).analyze()
+
+    def run():
+        return tft.reduce_blocks(
+            lambda y_input, z_input: {
+                "y": y_input.sum(axis=0),
+                "z": z_input.min(axis=0),
+            },
+            df,
+        )
+
+    dt = _timeit(run)
+    s, m = run()
+    np.testing.assert_allclose(np.asarray(m)[1], -(n_rows - 1))
+    return {
+        "metric": "config2_vector_reduce_rows_per_sec",
+        "value": round(n_rows / dt, 1),
+        "unit": "rows/s",
+        "seconds_per_pass": round(dt, 4),
+    }
+
+
+def config3_mnist_scoring(n_rows: int = 200_000) -> Dict:
+    """MNIST-LR scoring via map_blocks on a frozen model (bench.py metric)."""
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.models import MLPClassifier
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, 784)).astype(np.float32)
+    clf = MLPClassifier.init(0, [784, 10])
+    df = tft.TensorFrame.from_columns({"features": x}).analyze()
+
+    def run():
+        return clf.score_frame(df, "features").cache().column_block("prediction")
+
+    dt = _timeit(run)
+    return {
+        "metric": "config3_mnist_scoring_rows_per_sec",
+        "value": round(n_rows / dt, 1),
+        "unit": "rows/s",
+        "seconds_per_pass": round(dt, 4),
+    }
+
+
+def config4_image_scoring(n_rows: int = 2_000, dim: int = 4096) -> Dict:
+    """Embedding scoring via map_rows over binary rows: host decode + model
+    forward per row (the reference's VGG-over-binaryFiles shape)."""
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.models import MLPClassifier
+    from tensorframes_tpu.models.mlp import mlp_logits
+
+    rng = np.random.default_rng(0)
+    clf = MLPClassifier.init(0, [dim, 128])
+    raws = [
+        rng.normal(size=dim).astype(np.float32).tobytes()
+        for _ in range(n_rows)
+    ]
+    df = tft.TensorFrame.from_columns({"image_data": raws})
+    params = clf.params
+
+    def score(image_data):
+        x = np.frombuffer(image_data, dtype=np.float32)
+        return {"embedding": np.asarray(mlp_logits(params, x[None]))[0]}
+
+    def run():
+        return tft.map_rows(score, df).cache().column_block("embedding")
+
+    dt = _timeit(run, iters=2)
+    return {
+        "metric": "config4_image_scoring_rows_per_sec",
+        "value": round(n_rows / dt, 1),
+        "unit": "rows/s",
+        "seconds_per_pass": round(dt, 4),
+    }
+
+
+def config5_distributed_sgd(
+    n_rows: int = 262_144, dim: int = 64, steps: int = 10
+) -> Dict:
+    """Distributed SGD composed from the dataframe ops: map_blocks computes
+    per-block gradient partials, reduce_blocks sums them (the reference's
+    composition, DebugRowOps.scala:290-526), parameters update on the host.
+    Runs over the default mesh (all available devices)."""
+    import tensorframes_tpu as tft
+    import tensorframes_tpu.parallel as par
+
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=dim).astype(np.float32)
+    x = rng.normal(size=(n_rows, dim)).astype(np.float32)
+    y = (x @ w_true + 0.01 * rng.normal(size=n_rows)).astype(np.float32)
+    df = tft.TensorFrame.from_columns({"x": x, "y": y}).analyze()
+    mesh = par.make_mesh()
+
+    def grad_fn(x, y, w):
+        err = x @ w - y
+        return {"g": (x * err[:, None])[None].sum(axis=1)}
+
+    w = np.zeros(dim, dtype=np.float32)
+    lr = 0.1 / n_rows
+
+    def step(w):
+        partials = par.map_blocks(
+            grad_fn, df, mesh=mesh, trim=True, constants={"w": w}
+        ).cache().analyze()
+        g = par.reduce_blocks(
+            lambda g_input: {"g": g_input.sum(axis=0)}, partials, mesh=mesh
+        )
+        return w - lr * np.asarray(g)
+
+    w = step(w)  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        w = step(w)
+    dt = (time.perf_counter() - t0) / steps
+    err = float(np.linalg.norm(w - w_true) / np.linalg.norm(w_true))
+    return {
+        "metric": "config5_sgd_rows_per_sec",
+        "value": round(n_rows / dt, 1),
+        "unit": "rows/s",
+        "seconds_per_step": round(dt, 4),
+        "rel_param_error": round(err, 4),
+    }
+
+
+ALL_CONFIGS = {
+    1: config1_add3,
+    2: config2_vector_reduce,
+    3: config3_mnist_scoring,
+    4: config4_image_scoring,
+    5: config5_distributed_sgd,
+}

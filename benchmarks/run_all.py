@@ -1,0 +1,27 @@
+"""Run all five BASELINE configs; one JSON line each.
+
+Usage: ``python benchmarks/run_all.py [config_numbers...]``
+(no args = all). Runs on whatever backend jax selects (TPU when attached).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.configs import ALL_CONFIGS
+
+
+def main():
+    which = [int(a) for a in sys.argv[1:]] or sorted(ALL_CONFIGS)
+    for i in which:
+        try:
+            res = ALL_CONFIGS[i]()
+        except Exception as e:  # keep going; report the failure
+            res = {"metric": f"config{i}", "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,8 @@ from .capture import (
     load_graph,
     functions,
 )
+from .builder import OpBuilder
+from . import schema, utils
 
 __all__ = [
     # the reference's nine public functions (core.py:11-12)
@@ -91,6 +93,9 @@ __all__ = [
     "save_graph",
     "load_graph",
     "functions",
+    "OpBuilder",
+    "schema",
+    "utils",
     # errors
     "InputNotFoundError",
     "InvalidTypeError",

@@ -1,0 +1,169 @@
+"""Op-builder facade: the reference's Py4J builder flow, JVM-free.
+
+The reference's Python client drives a stateful JVM builder
+(``PythonOpBuilder``,
+``/root/reference/src/main/scala/org/tensorframes/impl/PythonInterface.scala:86-170``):
+accumulate a graph (bytes or file path), shape hints, fetches, and an input
+map, then ``buildDF()`` (maps/aggregates) or ``buildRow()`` (reduces). This
+facade keeps that calling convention for users porting reference code, over
+the native CapturedGraph/engine stack — no sockets, no JVM.
+
+Example (reference style)::
+
+    out = (OpBuilder.map_blocks(df)
+             .graph_from_file("prog.tfs")
+             .inputs({"x": "col_a"})
+             .build_df())
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .capture import CapturedGraph, deserialize_graph, load_graph
+from .frame import GroupedFrame, TensorFrame
+from .schema import Shape
+
+__all__ = ["OpBuilder"]
+
+_MAP_KINDS = ("map_blocks", "map_blocks_trimmed", "map_rows", "aggregate")
+_ROW_KINDS = ("reduce_blocks", "reduce_rows")
+
+
+class OpBuilder:
+    """Stateful builder: graph + hints + fetches + inputs -> engine call."""
+
+    def __init__(self, kind: str, dframe, trim: bool = False):
+        if kind not in _MAP_KINDS + _ROW_KINDS:
+            raise ValueError(f"unknown op kind {kind!r}")
+        self._kind = kind
+        self._df = dframe
+        self._trim = trim
+        self._graph: Optional[CapturedGraph] = None
+        self._fetches: Optional[List[str]] = None
+        self._hints: Dict[str, Shape] = {}
+        self._inputs: Dict[str, str] = {}
+
+    # -- constructors matching PythonInterface.scala:46-68 ------------------
+
+    @staticmethod
+    def map_blocks(dframe: TensorFrame, trim: bool = False) -> "OpBuilder":
+        return OpBuilder("map_blocks", dframe, trim=trim)
+
+    @staticmethod
+    def map_rows(dframe: TensorFrame) -> "OpBuilder":
+        return OpBuilder("map_rows", dframe)
+
+    @staticmethod
+    def reduce_blocks(dframe: TensorFrame) -> "OpBuilder":
+        return OpBuilder("reduce_blocks", dframe)
+
+    @staticmethod
+    def reduce_rows(dframe: TensorFrame) -> "OpBuilder":
+        return OpBuilder("reduce_rows", dframe)
+
+    @staticmethod
+    def aggregate_blocks(grouped: GroupedFrame) -> "OpBuilder":
+        return OpBuilder("aggregate", grouped)
+
+    # -- accumulation (PythonOpBuilder.graph/graphFromFile/shape/fetches/
+    # -- inputs, PythonInterface.scala:97-127) ------------------------------
+
+    def graph(self, data) -> "OpBuilder":
+        """Attach the program: serialized bytes or a CapturedGraph."""
+        if isinstance(data, CapturedGraph):
+            self._graph = data
+        elif isinstance(data, (bytes, bytearray)):
+            self._graph = deserialize_graph(bytes(data))
+        else:
+            raise TypeError("graph() takes serialized bytes or a CapturedGraph")
+        return self
+
+    def graph_from_file(self, path: str) -> "OpBuilder":
+        """Load a serialized program (reference ``graphFromFile``,
+        ``PythonInterface.scala:115-118``)."""
+        self._graph = load_graph(path)
+        return self
+
+    def shape(self, names: Sequence[str], shapes: Sequence[Sequence[int]]) -> "OpBuilder":
+        """Shape hints by tensor name (reference ``builder.shape``)."""
+        for name, dims in zip(names, shapes):
+            self._hints[name] = Shape.from_jax(
+                tuple(None if d in (-1, None) else int(d) for d in dims)
+            )
+        return self
+
+    def fetches(self, names: Sequence[str]) -> "OpBuilder":
+        self._fetches = list(names)
+        return self
+
+    def inputs(self, placeholder_names, field_names=None) -> "OpBuilder":
+        """Placeholder -> column map; accepts a dict or two parallel lists
+        (the reference's wire format, ``PythonInterface.scala:120-127``)."""
+        if field_names is None:
+            self._inputs.update(dict(placeholder_names))
+        else:
+            self._inputs.update(zip(placeholder_names, field_names))
+        return self
+
+    # -- build --------------------------------------------------------------
+
+    def _final_graph(self) -> CapturedGraph:
+        if self._graph is None:
+            raise ValueError("no graph attached; call graph()/graph_from_file()")
+        g = self._graph
+        if self._fetches is not None:
+            missing = [f for f in self._fetches if f not in g.fetch_names]
+            if missing:
+                raise KeyError(
+                    f"fetches {missing} not among program outputs "
+                    f"{g.fetch_names}"
+                )
+            if list(self._fetches) != g.fetch_names:
+                g = CapturedGraph(
+                    g.fn,
+                    list(g.placeholders.values()),
+                    self._fetches,
+                    g.inputs_map,
+                    g.shape_hints,
+                )
+        if self._inputs:
+            g = g.with_inputs(self._inputs)
+        if self._hints:
+            g = g.with_hints(
+                {k: v for k, v in self._hints.items() if k in g.fetch_names}
+            )
+        return g
+
+    def build_df(self) -> TensorFrame:
+        """Run a map/aggregate (reference ``buildDF``,
+        ``PythonInterface.scala:144-151``)."""
+        from . import engine
+
+        g = self._final_graph()
+        if self._kind == "map_blocks":
+            return engine.map_blocks(g, self._df, trim=self._trim)
+        if self._kind == "map_blocks_trimmed":
+            return engine.map_blocks(g, self._df, trim=True)
+        if self._kind == "map_rows":
+            return engine.map_rows(g, self._df)
+        if self._kind == "aggregate":
+            return engine.aggregate(g, self._df)
+        raise ValueError(f"build_df not valid for {self._kind!r}")
+
+    def build_row(self):
+        """Run a reduce (reference ``buildRow``,
+        ``PythonInterface.scala:129-142``)."""
+        from . import engine
+
+        g = self._final_graph()
+        if self._kind == "reduce_blocks":
+            return engine.reduce_blocks(g, self._df)
+        if self._kind == "reduce_rows":
+            return engine.reduce_rows(g, self._df)
+        raise ValueError(f"build_row not valid for {self._kind!r}")
+
+    # camelCase aliases matching the reference wire names
+    buildDF = build_df
+    buildRow = build_row
+    graphFromFile = graph_from_file

@@ -1,0 +1,18 @@
+"""Interop edges: Arrow, pandas, Spark.
+
+The reference lives *inside* Spark; this framework keeps Spark (and any
+other table source) at the edge, speaking Arrow as the interchange — the
+role protobuf GraphDef + Py4J played for programs is played for *data* by
+Arrow record batches (SURVEY §2.4).
+"""
+
+from .arrow import from_arrow, to_arrow
+from .spark import from_spark, to_spark, spark_available
+
+__all__ = [
+    "from_arrow",
+    "to_arrow",
+    "from_spark",
+    "to_spark",
+    "spark_available",
+]

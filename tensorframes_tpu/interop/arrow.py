@@ -1,0 +1,68 @@
+"""Arrow interchange.
+
+Dense numeric columns move zero-copy-ish (one ``to_numpy`` per column);
+list columns become ragged/dense vector columns and round-trip through the
+same (flat, offsets) layout the native packer uses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..frame import TensorFrame
+
+__all__ = ["from_arrow", "to_arrow"]
+
+
+def from_arrow(table, num_partitions: int = 1) -> TensorFrame:
+    """pyarrow.Table -> TensorFrame."""
+    import pyarrow as pa
+
+    data = {}
+    for name in table.column_names:
+        col = table.column(name).combine_chunks()
+        if isinstance(col, pa.ChunkedArray):
+            col = col.chunk(0) if col.num_chunks else pa.array([])
+        if col.null_count:
+            # same contract as the reference: "nullable fields are not
+            # accepted" (core.py:368)
+            raise ValueError(
+                f"Column {name!r} contains {col.null_count} null(s); "
+                f"nullable columns are not supported — fill or drop them "
+                f"before ingesting"
+            )
+        if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+            data[name] = [np.asarray(v) for v in col.to_pylist()]
+        elif pa.types.is_binary(col.type) or pa.types.is_string(col.type):
+            vals = col.to_pylist()
+            data[name] = [
+                v.encode() if isinstance(v, str) else v for v in vals
+            ]
+        else:
+            data[name] = col.to_numpy(zero_copy_only=False)
+    return TensorFrame.from_columns(data, num_partitions=num_partitions)
+
+
+def to_arrow(df: TensorFrame):
+    """TensorFrame -> pyarrow.Table."""
+    import pyarrow as pa
+
+    df.cache()
+    arrays = {}
+    for c in df.schema:
+        cd = df.column_data(c.name)
+        if cd.is_binary:
+            arrays[c.name] = pa.array(cd.cells, type=pa.binary())
+        elif cd.dense is not None and cd.dense.ndim == 1:
+            arrays[c.name] = pa.array(cd.dense)
+        elif cd.dense is not None and cd.dense.ndim == 2:
+            # uniform vector column: one flat buffer, no Python loop
+            flat = pa.array(np.ascontiguousarray(cd.dense).reshape(-1))
+            arrays[c.name] = pa.FixedSizeListArray.from_arrays(
+                flat, cd.dense.shape[1]
+            )
+        else:
+            arrays[c.name] = pa.array(
+                [np.asarray(v).tolist() for v in cd.iter_cells()]
+            )
+    return pa.table(arrays)

@@ -1,0 +1,56 @@
+"""Spark interop (optional; gated on pyspark being installed).
+
+The reference *is* a Spark package; here Spark is one possible table source
+at the edge: a Spark DataFrame is collected to Arrow and ingested, results
+go back as a Spark DataFrame. For datasets beyond one host, partition-wise
+streaming via ``mapInArrow`` is the intended growth path.
+"""
+
+from __future__ import annotations
+
+from ..frame import TensorFrame
+
+__all__ = ["spark_available", "from_spark", "to_spark"]
+
+
+def spark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _require_spark():
+    if not spark_available():
+        raise ImportError(
+            "pyspark is not installed; Spark interop is optional — install "
+            "pyspark or ingest via TensorFrame.from_arrow/from_pandas"
+        )
+
+
+def from_spark(spark_df, num_partitions: int = 0) -> TensorFrame:
+    """Spark DataFrame -> TensorFrame (via Arrow collect). ``num_partitions``
+    defaults to the Spark frame's partition count."""
+    _require_spark()
+    from .arrow import from_arrow
+
+    nparts = num_partitions
+    if not nparts:
+        try:  # Spark Connect sessions have no RDD API
+            nparts = spark_df.rdd.getNumPartitions()
+        except Exception:
+            nparts = 1
+    table = spark_df.toArrow() if hasattr(spark_df, "toArrow") else None
+    if table is None:
+        import pyarrow as pa
+
+        table = pa.Table.from_pandas(spark_df.toPandas())
+    return from_arrow(table, num_partitions=nparts)
+
+
+def to_spark(df: TensorFrame, spark):
+    """TensorFrame -> Spark DataFrame via pandas."""
+    _require_spark()
+    return spark.createDataFrame(df.to_pandas())

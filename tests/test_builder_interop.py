@@ -1,0 +1,116 @@
+"""OpBuilder facade + Arrow interop tests (analog of the reference's
+PythonInterface wire-protocol behavior + its data ingestion edge)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.capture import functions as F
+from tensorframes_tpu.interop import from_arrow, to_arrow, spark_available
+
+
+def saved_graph(tmp_path, df):
+    with tft.graph():
+        x = tft.block(df, "x")
+        g = tft.build_graph((x * 2.0).named("z"))
+    p = str(tmp_path / "g.tfs")
+    tft.save_graph(g, p)
+    return p
+
+
+class TestOpBuilder:
+    def test_map_blocks_from_file(self, tmp_path):
+        df = tft.TensorFrame.from_columns({"x": np.arange(4.0)})
+        p = saved_graph(tmp_path, df)
+        out = tft.OpBuilder.map_blocks(df).graph_from_file(p).build_df()
+        assert [r.z for r in out.collect()] == [0.0, 2.0, 4.0, 6.0]
+
+    def test_graph_bytes_and_inputs(self, tmp_path):
+        df = tft.TensorFrame.from_columns({"other": np.arange(3.0)})
+        df_x = tft.TensorFrame.from_columns({"x": np.arange(3.0)})
+        with tft.graph():
+            x = tft.block(df_x, "x")
+            g = tft.build_graph((x + 1.0).named("z"))
+        data = tft.serialize_graph(g)
+        out = (
+            tft.OpBuilder.map_blocks(df)
+            .graph(data)
+            .inputs({"x": "other"})
+            .build_df()
+        )
+        assert [r.z for r in out.collect()] == [1.0, 2.0, 3.0]
+
+    def test_reduce_build_row(self):
+        df = tft.TensorFrame.from_columns({"x": np.arange(5.0)}).select(
+            ("x", "x")
+        )
+        with tft.graph():
+            xin = tft.block(df, "x", tft_name="x_input")
+            g = tft.build_graph(F.reduce_sum(xin, axis=[0], name="x"))
+        out = tft.OpBuilder.reduce_blocks(df).graph(g).build_row()
+        assert float(out) == 10.0
+
+    def test_fetch_subset(self):
+        df = tft.TensorFrame.from_columns({"x": np.arange(3.0)})
+        g = tft.CapturedGraph.from_callable(
+            lambda x: {"a": x + 1, "b": x + 2},
+            {"x": (tft.schema.FLOAT64, tft.Shape(-1))},
+        )
+        out = (
+            tft.OpBuilder.map_blocks(df).graph(g).fetches(["b"]).build_df()
+        )
+        assert set(out.columns) == {"b", "x"}
+
+    def test_wire_name_aliases(self, tmp_path):
+        df = tft.TensorFrame.from_columns({"x": np.arange(2.0)})
+        p = saved_graph(tmp_path, df)
+        b = tft.OpBuilder.map_blocks(df)
+        out = b.graphFromFile(p).buildDF()
+        assert [r.z for r in out.collect()] == [0.0, 2.0]
+
+    def test_errors(self):
+        df = tft.TensorFrame.from_columns({"x": np.arange(2.0)})
+        with pytest.raises(ValueError, match="no graph"):
+            tft.OpBuilder.map_blocks(df).build_df()
+        with pytest.raises(ValueError, match="unknown op kind"):
+            tft.OpBuilder("nope", df)
+
+
+class TestArrowInterop:
+    def test_roundtrip_scalar_and_vector(self):
+        pa = pytest.importorskip("pyarrow")
+        t = pa.table(
+            {
+                "x": pa.array([1.0, 2.0, 3.0]),
+                "v": pa.array([[1, 2], [3, 4], [5, 6]]),
+            }
+        )
+        df = from_arrow(t)
+        assert df.num_rows == 3
+        assert df.schema["v"].nesting == 1
+        back = to_arrow(df.analyze())
+        assert back.column("x").to_pylist() == [1.0, 2.0, 3.0]
+        assert back.column("v").to_pylist()[2] == [5, 6]
+
+    def test_binary_column(self):
+        pa = pytest.importorskip("pyarrow")
+        t = pa.table({"b": pa.array([b"ab", b"c"], type=pa.binary())})
+        df = from_arrow(t)
+        assert df.schema["b"].scalar_type.name == "binary"
+        back = to_arrow(df)
+        assert back.column("b").to_pylist() == [b"ab", b"c"]
+
+    def test_engine_over_arrow_frame(self):
+        pa = pytest.importorskip("pyarrow")
+        t = pa.table({"x": pa.array(np.arange(6.0))})
+        df = from_arrow(t, num_partitions=2)
+        out = tft.reduce_blocks(lambda x_input: {"x": x_input.sum()}, df)
+        assert float(out) == 15.0
+
+
+def test_spark_gated():
+    if not spark_available():
+        from tensorframes_tpu.interop import from_spark
+
+        with pytest.raises(ImportError, match="pyspark"):
+            from_spark(None)
