@@ -9,8 +9,18 @@ from .mlp import (
     softmax_cross_entropy,
 )
 from .kmeans import kmeans, assign_clusters
+from .transformer import (
+    TransformerLM,
+    init_transformer,
+    transformer_logits,
+    transformer_loss,
+)
 
 __all__ = [
+    "TransformerLM",
+    "init_transformer",
+    "transformer_logits",
+    "transformer_loss",
     "MLPClassifier",
     "init_mlp",
     "mlp_apply",

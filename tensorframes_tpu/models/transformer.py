@@ -1,0 +1,217 @@
+"""Decoder-only transformer LM on the framework's attention stack.
+
+The reference's deepest model workload is scoring a frozen VGG/Inception
+graph through the dataframe ops (``read_image.py:147-167``); this module is
+the modern analog: a transformer whose attention runs on the Pallas flash
+kernel single-chip (:func:`tensorframes_tpu.ops.flash_attention`) or on
+ring attention across the ``sp`` mesh axis for long sequences
+(:func:`tensorframes_tpu.ops.ring_attention`), and whose scoring dispatches
+through ``map_blocks`` like any other captured program.
+
+Architecture: learned positional embeddings, pre-LN blocks
+(MHA -> residual, GELU MLP -> residual), final LN, tied output head.
+All matmuls stay [tokens, d] x [d, d'] so XLA tiles them onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "init_transformer",
+    "transformer_logits",
+    "transformer_loss",
+    "token_nll",
+    "TransformerLM",
+]
+
+Params = Dict[str, Any]
+
+
+def init_transformer(
+    seed: int,
+    vocab: int,
+    d_model: int = 64,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    max_len: int = 128,
+    d_ff: Optional[int] = None,
+    dtype=np.float32,
+) -> Params:
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} must divide by n_heads {n_heads}")
+    d_ff = d_ff or 4 * d_model
+    rng = np.random.default_rng(seed)
+
+    def dense(fan_in, fan_out):
+        return (rng.normal(0, fan_in**-0.5, (fan_in, fan_out))).astype(dtype)
+
+    params: Params = {
+        "embed": (rng.normal(0, 0.02, (vocab, d_model))).astype(dtype),
+        "pos": (rng.normal(0, 0.02, (max_len, d_model))).astype(dtype),
+        "blocks": [],
+        "ln_f": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
+        "n_heads": n_heads,
+    }
+    for _ in range(n_layers):
+        params["blocks"].append(
+            {
+                "ln1": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
+                "qkv": dense(d_model, 3 * d_model),
+                "proj": dense(d_model, d_model),
+                "ln2": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
+                "up": dense(d_model, d_ff),
+                "down": dense(d_ff, d_model),
+            }
+        )
+    return params
+
+
+def _ln(x, p):
+    import jax.numpy as jnp
+
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * p["g"] + p["b"]
+
+
+def _attention(x, block, n_heads, causal, attn_impl, mesh):
+    import jax.numpy as jnp
+
+    from ..ops import attention_reference, flash_attention, ring_attention
+
+    bsz, length, d = x.shape
+    hd = d // n_heads
+    qkv = x @ block["qkv"]  # [B, L, 3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):  # [B, L, D] -> [B, H, L, hd]
+        return t.reshape(bsz, length, n_heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    if attn_impl == "ring":
+        o = ring_attention(q, k, v, mesh=mesh, causal=causal)
+    elif attn_impl == "flash":
+        o = flash_attention(q, k, v, causal=causal)
+    else:
+        o = attention_reference(q, k, v, causal=causal)
+    o = o.transpose(0, 2, 1, 3).reshape(bsz, length, d)
+    return o @ block["proj"]
+
+
+def transformer_logits(
+    params: Params,
+    tokens,
+    causal: bool = True,
+    attn_impl: str = "reference",
+    mesh=None,
+):
+    """``tokens`` [B, L] int32 -> logits [B, L, vocab].
+
+    ``attn_impl``: "reference" (dense, XLA-fused — best for short L),
+    "flash" (Pallas kernel), or "ring" (sequence-parallel over ``mesh``)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_heads = params["n_heads"]
+    length = tokens.shape[1]
+    # params may be host numpy (frozen-model scoring closes over them);
+    # jnp-ify before indexing with traced token ids
+    embed = jnp.asarray(params["embed"])
+    pos = jnp.asarray(params["pos"])
+    x = embed[tokens] + pos[:length][None]
+    for block in params["blocks"]:
+        h = _ln(x, block["ln1"])
+        x = x + _attention(h, block, n_heads, causal, attn_impl, mesh)
+        h = _ln(x, block["ln2"])
+        x = x + jax.nn.gelu(h @ block["up"]) @ block["down"]
+    x = _ln(x, params["ln_f"])
+    return x @ embed.T
+
+
+def token_nll(
+    params: Params, tokens, attn_impl: str = "reference", mesh=None
+):
+    """Per-position next-token negative log-likelihood ``[B, L-1]`` — the
+    one implementation both training loss and frame scoring reduce over."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = transformer_logits(
+        params, tokens[:, :-1], causal=True, attn_impl=attn_impl, mesh=mesh
+    )
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, targets[..., None].astype(jnp.int32), axis=-1
+    )
+    return -picked[..., 0]
+
+
+def transformer_loss(
+    params: Params, tokens, attn_impl: str = "reference", mesh=None
+):
+    """Next-token cross entropy (mean over all predicted positions)."""
+    return token_nll(params, tokens, attn_impl=attn_impl, mesh=mesh).mean()
+
+
+class TransformerLM:
+    """Parameter holder + frame scoring + simple SGD fitting."""
+
+    def __init__(self, params: Params):
+        self.params = params
+
+    @staticmethod
+    def init(seed: int, vocab: int, **kw) -> "TransformerLM":
+        return TransformerLM(init_transformer(seed, vocab, **kw))
+
+    def fit(self, tokens: np.ndarray, steps: int = 10, lr: float = 0.1):
+        """Plain jitted SGD on next-token loss (single chip)."""
+        import jax
+
+        static = self.params["n_heads"]
+
+        def loss_fn(p, toks):
+            return transformer_loss({**p, "n_heads": static}, toks)
+
+        @jax.jit
+        def step(p, toks):
+            loss, g = jax.value_and_grad(loss_fn)(p, toks)
+            return jax.tree.map(lambda a, b: a - lr * b, p, g), loss
+
+        p = {k: v for k, v in self.params.items() if k != "n_heads"}
+        losses = []
+        toks = np.asarray(tokens, dtype=np.int32)
+        for _ in range(steps):
+            p, loss = step(p, toks)
+            losses.append(float(loss))
+        self.params = {**jax.device_get(p), "n_heads": static}
+        return losses
+
+    def score_frame(
+        self, df, col: str, loss_col: str = "nll", attn_impl: str = "reference"
+    ):
+        """Per-row next-token NLL appended as a column: the transformer
+        version of frozen-graph scoring through ``map_blocks``."""
+        import jax.numpy as jnp
+
+        from ..engine import map_blocks
+
+        params = self.params
+
+        def fn(**cols):
+            toks = cols[col].astype(jnp.int32)
+            return {
+                loss_col: token_nll(params, toks, attn_impl=attn_impl).mean(
+                    axis=-1
+                )
+            }
+
+        import inspect
+
+        fn.__signature__ = inspect.Signature(
+            [inspect.Parameter(col, inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+        )
+        return map_blocks(fn, df)

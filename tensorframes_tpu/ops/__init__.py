@@ -1,0 +1,12 @@
+"""Hot-op kernels: Pallas flash attention + ring sequence parallelism."""
+
+from .attention import flash_attention, attention_reference, online_block_update
+from .ring import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "flash_attention",
+    "attention_reference",
+    "online_block_update",
+    "ring_attention",
+    "ring_attention_sharded",
+]

@@ -1,0 +1,233 @@
+"""Attention: online-softmax math + a Pallas TPU flash-attention kernel.
+
+The reference has no attention or sequence axis at all (SURVEY §5: its only
+scalable axis is rows). Long context is first-class here: this module is the
+single-chip building block, and :mod:`tensorframes_tpu.ops.ring` scales the
+sequence axis across chips with the same online-softmax update, so the two
+compose into ring attention (blockwise parallel attention over a mesh).
+
+Layout convention: ``[batch, heads, seq, head_dim]``.
+
+The kernel tiles queries over the grid and streams key/value blocks through
+an online-softmax accumulator (running max ``m``, normalizer ``l``, output
+accumulator ``acc``) held in the loop carry — the standard FlashAttention
+recurrence, shaped for the MXU: every contraction is a dense
+``[block_q, d] x [d, block_k]`` / ``[block_q, block_k] x [block_k, d]``
+matmul with ``preferred_element_type=f32``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["flash_attention", "attention_reference", "online_block_update"]
+
+_NEG_BIG = -0.7 * float(np.finfo(np.float32).max)  # mask value; exp() == 0
+
+
+def online_block_update(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    m: jnp.ndarray,
+    l: jnp.ndarray,
+    acc: jnp.ndarray,
+    scale: float,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One online-softmax accumulation step over a key/value block.
+
+    ``q``: [bq, d]; ``k``/``v``: [bk, d]; carry ``m``/``l``: [bq, 1],
+    ``acc``: [bq, d] (all f32). ``mask``: optional [bq, bk] bool, True =
+    attend. Fully-masked prefixes are handled: rows that have seen no valid
+    key keep ``l == 0`` and contribute nothing. Shared verbatim by the
+    Pallas kernel and the ring step so single-chip and distributed paths
+    compute identically."""
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_BIG)
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    # rows still fully masked keep m == _NEG_BIG; exp(s - m) would be
+    # exp(0) = 1 for masked entries, so re-mask p explicitly
+    p = jnp.exp(s - m_new)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+    acc_new = alpha * acc + jax.lax.dot_general(
+        p,
+        v.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
+
+
+def _finalize(l: jnp.ndarray, acc: jnp.ndarray) -> jnp.ndarray:
+    return acc / jnp.maximum(l, 1e-30)
+
+
+def attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False
+) -> jnp.ndarray:
+    """Dense softmax attention oracle, [B, H, L, D] layout."""
+    scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        lq, lk = q.shape[2], k.shape[2]
+        qi = jnp.arange(lq)[:, None] + (lk - lq)
+        ki = jnp.arange(lk)[None, :]
+        s = jnp.where(qi >= ki, s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, block_q, block_k, causal, offset, scale,
+):
+    """Grid = (batch*heads, q_blocks, k_blocks); the k axis is innermost and
+    sequential on TPU, so the VMEM scratch carries the online-softmax state
+    across k steps — only one [block_k, d] key/value tile is resident at a
+    time (true streaming; context length is HBM-bound, not VMEM-bound).
+
+    ``offset = lk - lq`` aligns the causal diagonal bottom-right, matching
+    :func:`attention_reference` for cross-length attention."""
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def update():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        kj = k_ref[0]
+        vj = v_ref[0]
+        mask = None
+        if causal:
+            q_pos = offset + iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            mask = q_pos >= k_pos
+        m, l, acc = online_block_update(
+            q, kj, vj, m_scr[:], l_scr[:], acc_scr[:], scale, mask
+        )
+        m_scr[:] = m
+        l_scr[:] = l
+        acc_scr[:] = acc
+
+    if causal:
+        # causal frontier: skip key blocks entirely in the masked future
+        visible = ik * block_k <= offset + (iq + 1) * block_q - 1
+
+        @pl.when(visible)
+        def _():
+            update()
+
+    else:
+        update()
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        o_ref[0] = _finalize(l_scr[:], acc_scr[:]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Tiled attention, [B, H, L, D] layout.
+
+    One grid step owns one (query block, key block) pair; the online-softmax
+    state lives in VMEM scratch across the key axis, so K/V stream through
+    VMEM one tile at a time. Sequence lengths must be multiples of the block
+    sizes (callers pad; the ring layer shards to equal chunks anyway).
+    Causal masking aligns the diagonal bottom-right when ``lq != lk`` (same
+    convention as :func:`attention_reference`). ``interpret`` defaults to
+    True off-TPU so tests run on CPU."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"sequence lengths ({lq}, {lk}) must be multiples of the block "
+            f"sizes ({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    scale = 1.0 / float(np.sqrt(d))
+    bh = b * h
+    qf = q.reshape(bh, lq, d)
+    kf = k.reshape(bh, lk, d)
+    vf = v.reshape(bh, lk, d)
+    kernel = functools.partial(
+        _flash_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+        offset=lk - lq,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, lq // block_q, lk // block_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d), lambda bi, qi, ki: (bi, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bi, qi, ki: (bi, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bi, qi, ki: (bi, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bi, qi, ki: (bi, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, lq, d)

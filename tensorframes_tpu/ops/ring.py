@@ -1,0 +1,147 @@
+"""Ring attention: sequence/context parallelism over the device mesh.
+
+Long sequences are sharded along the sequence axis, one chunk per chip on
+the ``sp`` mesh axis. Each chip keeps its query chunk resident and the
+key/value chunks rotate around the ring with ``lax.ppermute`` (ICI
+neighbor exchange), one hop per step; the partial attention of the local
+queries against the visiting k/v chunk folds into the same online-softmax
+carry the single-chip flash kernel uses
+(:func:`tensorframes_tpu.ops.attention.online_block_update`). After
+``num_chips`` steps every query has attended every key, with communication
+overlapped against the block computation by XLA — no chip ever holds more
+than its own chunk plus one visiting chunk.
+
+This is the blockwise/ring formulation (cf. Ring Attention; see PAPERS.md)
+— the reference has nothing comparable (no attention, no sequence axis,
+SURVEY §5); its closest mechanism, the rows-axis pairwise reduce, shaped
+the same "local partials + rotating merge" design used here.
+
+Causality is handled at chunk granularity with global position offsets:
+chunk ``c`` of keys is masked against local queries using the ring-rotated
+source index, so the math matches a dense causal mask exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .attention import _NEG_BIG, _finalize, online_block_update
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+SEQ_AXIS = "sp"
+
+
+def _local_ring_step(q, kc, vc, m, l, acc, q_off, k_off, causal, scale):
+    """Fold one visiting k/v chunk into the carry. Shapes: q [B,H,Lq,D],
+    kc/vc [B,H,Lc,D], carry m/l [B,H,Lq,1], acc [B,H,Lq,D]."""
+    lq = q.shape[2]
+    lc = kc.shape[2]
+    mask = None
+    if causal:
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (lq, lc), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (lq, lc), 1)
+        mask = q_pos >= k_pos  # shared 2-D mask for every batch/head
+
+    def per_head(qh, kh, vh, mh, lh, acch):
+        return online_block_update(qh, kh, vh, mh, lh, acch, scale, mask)
+
+    # vmap over batch and heads; the inner update is 2-D MXU-friendly
+    f = jax.vmap(jax.vmap(per_head))
+    return f(q, kc, vc, m, l, acc)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    axis_name: str = SEQ_AXIS,
+):
+    """The per-shard body: call inside ``shard_map`` with q/k/v sequence
+    chunks ``[B, H, L/n, D]`` sharded over ``axis_name``. Returns the local
+    output chunk."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, lq, d = q.shape
+    lc = k.shape[2]
+    scale = 1.0 / float(np.sqrt(d))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def _vary(x):
+        # constants born inside shard_map are device-invariant; the loop
+        # carry becomes sp-varying after the first ppermute, so the initial
+        # carry must be marked varying too (jax >= 0.8 VMA checking)
+        try:
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            return x
+
+    m0 = _vary(jnp.full((b, h, lq, 1), _NEG_BIG, dtype=jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, lq, 1), dtype=jnp.float32))
+    acc0 = _vary(jnp.zeros((b, h, lq, d), dtype=jnp.float32))
+    q_off = my * lq
+
+    def body(step, carry):
+        m, l, acc, kc, vc = carry
+        src = (my - step) % n  # which global chunk is visiting
+        k_off = src * lc
+        m, l, acc = _local_ring_step(
+            q, kc, vc, m, l, acc, q_off, k_off, causal, scale
+        )
+        # rotate k/v to the next chip (ICI neighbor hop)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return m, l, acc, kc, vc
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, acc0, k, v))
+    return _finalize(l, acc).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_program(mesh, causal: bool, axis_name: str):
+    """One jitted shard_map program per (mesh, causal, axis) — cached so
+    repeated calls (every transformer layer, every step) hit the jit cache
+    instead of retracing."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    return jax.jit(
+        jax.shard_map(
+            functools.partial(
+                ring_attention_sharded, causal=causal, axis_name=axis_name
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+    )
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh=None,
+    causal: bool = False,
+    axis_name: str = SEQ_AXIS,
+):
+    """Full-array entry point: shards ``[B, H, L, D]`` inputs over the
+    mesh's ``axis_name`` axis, runs the ring, and returns the assembled
+    ``[B, H, L, D]`` output. ``L`` must divide by the axis size."""
+    if mesh is None:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh({axis_name: len(jax.devices())})
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n or k.shape[2] % n:
+        raise ValueError(
+            f"sequence length {q.shape[2]} must divide by the {axis_name} "
+            f"axis size {n}"
+        )
+    return _ring_program(mesh, causal, axis_name)(q, k, v)

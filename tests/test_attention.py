@@ -1,0 +1,105 @@
+"""Attention tests: flash kernel vs dense oracle (CPU interpret mode) and
+ring attention over the virtual sp mesh vs the same oracle."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensorframes_tpu.ops import (
+    attention_reference,
+    flash_attention,
+    ring_attention,
+)
+from tensorframes_tpu.parallel import make_mesh
+
+
+def qkv(rng, b=2, h=2, l=32, d=8, dtype=np.float32):
+    def mk():
+        return jnp.asarray(rng.normal(size=(b, h, l, d)).astype(dtype))
+
+    return mk(), mk(), mk()
+
+
+@pytest.fixture(scope="module")
+def nprng():
+    return np.random.default_rng(0)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, nprng, causal):
+        q, k, v = qkv(nprng)
+        out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_multiple_kv_blocks(self, nprng):
+        q, k, v = qkv(nprng, l=64)
+        out = flash_attention(q, k, v, block_q=16, block_k=8)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_cross_attention_lengths(self, nprng):
+        rng = nprng
+        q = jnp.asarray(rng.normal(size=(1, 2, 16, 8)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 2, 48, 8)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 2, 48, 8)).astype(np.float32))
+        out = flash_attention(q, k, v, block_q=16, block_k=16)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_causal_cross_attention_offset(self, nprng):
+        # lq != lk: the causal diagonal aligns bottom-right (decoder step
+        # batches); kernel must apply the lk - lq offset
+        rng = nprng
+        q = jnp.asarray(rng.normal(size=(1, 2, 16, 8)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 2, 48, 8)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 2, 48, 8)).astype(np.float32))
+        out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_bad_block_size(self, nprng):
+        q, k, v = qkv(nprng, l=30)
+        with pytest.raises(ValueError, match="multiples"):
+            flash_attention(q, k, v, block_q=16, block_k=16)
+
+    def test_first_row_causal(self, nprng):
+        # the first query attends only to itself: softmax over one key
+        q, k, v = qkv(nprng, b=1, h=1, l=16)
+        out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8)
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 0, 0], np.asarray(v)[0, 0, 0], rtol=1e-5
+        )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, nprng, causal):
+        mesh = make_mesh({"sp": 4})
+        q, k, v = qkv(nprng, l=32)
+        out = ring_attention(q, k, v, mesh=mesh, causal=causal)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_eight_way(self, nprng):
+        mesh = make_mesh({"sp": 8})
+        q, k, v = qkv(nprng, l=64, d=4)
+        out = ring_attention(q, k, v, mesh=mesh, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_matches_flash_single_chip(self, nprng):
+        mesh = make_mesh({"sp": 4})
+        q, k, v = qkv(nprng, l=32)
+        ring = ring_attention(q, k, v, mesh=mesh, causal=True)
+        flash = flash_attention(q, k, v, causal=True, block_q=8, block_k=8)
+        np.testing.assert_allclose(ring, flash, rtol=2e-5, atol=2e-5)
+
+    def test_indivisible_length_rejected(self, nprng):
+        mesh = make_mesh({"sp": 4})
+        q, k, v = qkv(nprng, l=30)
+        with pytest.raises(ValueError, match="divide"):
+            ring_attention(q, k, v, mesh=mesh)
