@@ -1,0 +1,69 @@
+"""Frame persistence: Parquet + tensor-schema sidecar.
+
+The reference has no persistence of its own — results are Spark DataFrames
+and durability is the user's ``cache()``/write (SURVEY §5). Here frames
+save/load directly: data as Parquet (via the Arrow interop), the analyzed
+tensor metadata (shapes/dtypes the Parquet schema can't express) in the
+Parquet key-value metadata, so ``load_frame`` restores exactly what
+``analyze`` had inferred.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..frame import TensorFrame
+from ..schema import ColumnInfo, FrameInfo
+
+__all__ = ["save_frame", "load_frame"]
+
+_META_KEY = b"tensorframes_tpu.schema"
+
+
+def save_frame(df: TensorFrame, path: str) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from .arrow import to_arrow
+
+    table = to_arrow(df)
+    meta = {
+        "columns": [
+            {"name": c.name, **c.to_metadata()} for c in df.schema
+        ],
+        "num_partitions": df.num_partitions,
+    }
+    existing = table.schema.metadata or {}
+    table = table.replace_schema_metadata(
+        {**existing, _META_KEY: json.dumps(meta).encode()}
+    )
+    pq.write_table(table, path)
+
+
+def load_frame(path: str) -> TensorFrame:
+    import pyarrow.parquet as pq
+
+    from .arrow import from_arrow
+
+    table = pq.read_table(path)
+    meta_raw = (table.schema.metadata or {}).get(_META_KEY)
+    nparts = 1
+    infos = None
+    if meta_raw:
+        meta = json.loads(meta_raw.decode())
+        nparts = int(meta.get("num_partitions", 1))
+        infos = {
+            c["name"]: ColumnInfo.from_metadata(c["name"], c)
+            for c in meta.get("columns", [])
+        }
+    df = from_arrow(table, num_partitions=nparts)
+    if infos:
+        merged = [
+            infos.get(c.name, c).with_name(c.name) for c in df.schema
+        ]
+        df = TensorFrame(
+            {n: df.column_data(n) for n in df.columns},
+            FrameInfo(merged),
+            num_partitions=nparts,
+        )
+    return df

@@ -1,0 +1,102 @@
+"""Model / training-state checkpointing.
+
+The reference has **no** trainable-state checkpointing at all — model state
+lives inside the shipped graph as frozen constants (SURVEY §5,
+``core.py:41-55``). Training on TPU makes this a first-class subsystem:
+param pytrees (incl. sharded arrays) save/restore via Orbax, with a small
+manager for step-numbered checkpoints and resume.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(path: str, tree: Any) -> None:
+    """Save a pytree (params/opt state) to ``path`` (a directory)."""
+    ckpt = _checkpointer()
+    ckpt.save(os.path.abspath(path), tree, force=True)
+    ckpt.wait_until_finished()
+
+
+def restore_checkpoint(path: str, template: Optional[Any] = None) -> Any:
+    """Restore a pytree. ``template`` (a matching pytree of arrays or
+    ShapeDtypeStructs, possibly sharded) guides dtypes/shardings; without it
+    the stored structure is returned as saved."""
+    import orbax.checkpoint as ocp
+
+    ckpt = _checkpointer()
+    if template is not None:
+        import jax
+
+        targets = jax.tree.map(
+            lambda x: ocp.utils.to_shape_dtype_struct(x)
+            if hasattr(x, "shape")
+            else x,
+            template,
+        )
+        return ckpt.restore(os.path.abspath(path), targets)
+    return ckpt.restore(os.path.abspath(path))
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention + resume.
+
+    >>> mgr = CheckpointManager("/ckpts", max_to_keep=3)
+    >>> mgr.save(step, params)
+    >>> step, params = mgr.restore_latest(template=params)
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, tree: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, template: Optional[Any] = None):
+        import orbax.checkpoint as ocp
+
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, None
+        if template is not None:
+            import jax
+
+            targets = jax.tree.map(
+                lambda x: ocp.utils.to_shape_dtype_struct(x)
+                if hasattr(x, "shape")
+                else x,
+                template,
+            )
+            tree = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(targets)
+            )
+        else:
+            tree = self._mgr.restore(step)
+        return step, tree
+
+    def close(self):
+        self._mgr.close()
