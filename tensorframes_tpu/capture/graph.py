@@ -218,8 +218,25 @@ class CapturedGraph:
         ``input_shapes`` refines placeholder shapes (e.g. with a frame's
         analyzed block shapes). Returns fetch name -> TensorSpec. Shape hints
         override inference, mirroring how the reference lets hint shapes win
-        (``TensorFlowOps.scala:126-133``)."""
+        (``TensorFlowOps.scala:126-133``).
+
+        Memoized per input-shape signature: repeated ops on frames with the
+        same block shapes (the steady state of any iterative pipeline) skip
+        the abstract trace entirely — the reference re-runs ``analyzeGraphTF``
+        on the driver per call."""
         import jax
+
+        cache_key = (
+            share_lead,
+            tuple(
+                sorted((k, v.dims) for k, v in (input_shapes or {}).items())
+            ),
+        )
+        cache = getattr(self, "_analyze_cache", None)
+        if cache is None:
+            cache = self._analyze_cache = {}
+        if cache_key in cache:
+            return cache[cache_key]
 
         specs = []
         for ph in self.placeholders.values():
@@ -250,6 +267,7 @@ class CapturedGraph:
                 else _shape_from_abstract(o.shape)
             )
             result[name] = TensorSpec(name, for_numpy_dtype(o.dtype), shape)
+        cache[cache_key] = result
         return result
 
     def _concrete_probe(self, specs: Sequence[TensorSpec]):

@@ -204,16 +204,23 @@ def _jitted_vmap(g: CapturedGraph):
 
 
 def _block_feeder(cd):
-    """Per-partition feed source for a dense column: the memoized device
-    copy (sliced on device) when the column fits the device-cache budget,
-    else host slices streamed one block at a time so HBM stays bounded by a
-    single block."""
+    """Per-partition feed source for a dense column, plus whether it streams.
+
+    Returns ``(feed_fn, streams_host)``: the memoized device copy (sliced on
+    device) when the column fits the device-cache budget, else host slices
+    streamed one block at a time so HBM stays bounded by a single block.
+    Device-resident columns (results of a previous op) feed directly — no
+    transfer, no budget check."""
+    from ..frame.table import _is_device_array
     from ..utils import get_config
 
-    if cd.dense.nbytes <= get_config().device_cache_bytes:
+    dense = cd.dense
+    if _is_device_array(dense):
+        return (lambda lo, hi: dense[lo:hi]), False
+    if dense.nbytes <= get_config().device_cache_bytes:
         dev = cd.device()
-        return lambda lo, hi: dev[lo:hi]
-    return lambda lo, hi: cd.dense[lo:hi]
+        return (lambda lo, hi: dev[lo:hi]), False
+    return (lambda lo, hi: dense[lo:hi]), True
 
 
 def _ensure_precision(g: CapturedGraph, schema: FrameInfo) -> None:
@@ -307,13 +314,31 @@ def map_blocks(
     }
 
     def thunk() -> TensorFrame:
-        pieces: Dict[str, List[np.ndarray]] = {n: [] for n in fetch_names}
+        from ..utils import get_config
+
+        pieces: Dict[str, List] = {n: [] for n in fetch_names}
         part_sizes: List[int] = []
         # device-resident columns when they fit; streamed blocks otherwise
         feeders = {}
+        streaming = False
         for ph, col in binding.items():
             parent.column_block(col, None)  # rejects ragged/binary
-            feeders[ph] = _block_feeder(parent.column_data(col))
+            feeders[ph], streams = _block_feeder(parent.column_data(col))
+            streaming = streaming or streams
+        # Outputs stay device-resident only when HBM stays bounded: if any
+        # input streams from the host (over-budget column), or the full
+        # output itself would blow the device-cache budget, pull each
+        # partition's result to host as it lands (the pre-device-residency
+        # behavior), keeping peak HBM at ~one block.
+        if not streaming and not trim:
+            est = 0
+            for spec in out_specs.values():
+                cell = spec.shape.tail()
+                if all(d != Unknown for d in cell.dims):
+                    est += (
+                        int(np.prod(cell.dims)) if cell.dims else 1
+                    ) * spec.scalar_type.np_dtype.itemsize * parent.num_rows
+            streaming = est > get_config().device_cache_bytes
         for p in range(parent.num_partitions):
             lo, hi = parent.partition_bounds()[p]
             n = hi - lo
@@ -323,25 +348,42 @@ def map_blocks(
             feed = {ph: feeders[ph](lo, hi) for ph in binding}
             feed.update(const_feed)
             res = jit_fn(feed)
+            # results stay device-resident: shape checks need no host sync,
+            # and the host transfer happens only on host access (collect /
+            # column host materialization) — chained ops feed from HBM
             out_n = None
             for name in fetch_names:
-                arr = np.asarray(res[name])
+                arr = res[name]
                 if not trim and arr.shape[0] != n:
                     raise ValueError(
                         f"map_blocks output {name!r} produced {arr.shape[0]} "
                         f"rows for a block of {n}; only trimmed maps may "
                         f"change the row count"
                     )
+                if trim and out_n is not None and arr.shape[0] != out_n:
+                    raise ValueError(
+                        f"map_blocks(trim=True) fetches disagree on the "
+                        f"output row count in partition {p}: {name!r} "
+                        f"produced {arr.shape[0]} rows, a previous fetch "
+                        f"produced {out_n}"
+                    )
                 out_n = arr.shape[0]
-                pieces[name].append(arr)
+                pieces[name].append(np.asarray(arr) if streaming else arr)
             part_sizes.append(out_n if trim else n)
         cols: Dict[str, _ColumnData] = {}
         for name in fetch_names:
-            if pieces[name]:
-                dense = np.concatenate(pieces[name], axis=0)
-            else:
+            ps = pieces[name]
+            if not ps:
                 dense = _empty_output(out_specs[name], block_output=True)
-            cols[name] = _ColumnData(dense=np.ascontiguousarray(dense))
+            elif len(ps) == 1:
+                dense = ps[0]
+            elif streaming:
+                dense = np.concatenate(ps, axis=0)
+            else:
+                import jax.numpy as jnp
+
+                dense = jnp.concatenate(ps, axis=0)  # on-device concat
+            cols[name] = _ColumnData(dense=dense)
         offsets = np.concatenate([[0], np.cumsum(part_sizes)]).astype(np.int64)
         if trim:
             return TensorFrame(cols, result_info, offsets=offsets)
@@ -456,7 +498,7 @@ def map_rows(
                 for ph in binding:
                     cd = col_data[ph]
                     if cd.dense is not None:
-                        feed[ph] = gather_rows(cd.dense, idx_arr)
+                        feed[ph] = gather_rows(cd.host(), idx_arr)
                     elif ph in ragged_bufs:
                         feed[ph] = ragged_bufs[ph].gather_pad(idx_arr)
                     else:
@@ -511,7 +553,7 @@ def reduce_blocks(fetches, dframe: TensorFrame):
     feeders = {}
     for f, col in binding.items():
         dframe.column_block(col, None)  # rejects ragged/binary
-        feeders[f] = _block_feeder(dframe.column_data(col))
+        feeders[f], _ = _block_feeder(dframe.column_data(col))
     partials: List[Dict[str, Any]] = []
     for p in range(dframe.num_partitions):
         lo, hi = dframe.partition_bounds()[p]
@@ -580,7 +622,7 @@ def reduce_rows(fetches, dframe: TensorFrame):
     feeders = {}
     for f, col in binding.items():
         dframe.column_block(col, None)  # rejects ragged/binary
-        feeders[f] = _block_feeder(dframe.column_data(col))
+        feeders[f], _ = _block_feeder(dframe.column_data(col))
     partials: List[Dict[str, Any]] = []
     for p in range(dframe.num_partitions):
         lo, hi = dframe.partition_bounds()[p]

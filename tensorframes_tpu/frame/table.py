@@ -75,20 +75,31 @@ def _as_cell(v) -> Any:
     return np.asarray(v)[()]  # python scalar -> numpy scalar
 
 
-class _ColumnData:
-    """One column's storage. ``dense`` is an ndarray [n, *cell]; ``cells`` is
-    a list of per-row payloads (ragged / binary). ``device()`` memoizes the
-    on-device copy — columns are immutable, so a frame that is fed to the
-    engine repeatedly pays the host->device transfer once (the reference
-    re-marshals every Session.run, ``TFDataOps.scala:27-59``)."""
+def _is_device_array(a) -> bool:
+    """True for jax device arrays (host numpy otherwise)."""
+    return not isinstance(a, np.ndarray) and hasattr(a, "addressable_shards")
 
-    __slots__ = ("dense", "cells", "is_binary", "_device_arr", "_sharded_cache")
+
+class _ColumnData:
+    """One column's storage. ``dense`` is a [n, *cell] array — host numpy
+    *or* a device-resident jax array (engine results stay on device so
+    chained ops never round-trip through the host; the reference re-marshals
+    every Session.run, ``TFDataOps.scala:27-59``). ``cells`` is a list of
+    per-row payloads (ragged / binary). ``device()``/``host()`` memoize the
+    other-side copy — columns are immutable, so each transfer happens once.
+    """
+
+    __slots__ = (
+        "dense", "cells", "is_binary", "_device_arr", "_host_arr",
+        "_sharded_cache",
+    )
 
     def __init__(self, dense=None, cells=None, is_binary=False):
-        self.dense: Optional[np.ndarray] = dense
+        self.dense = dense  # np.ndarray | jax.Array | None
         self.cells: Optional[List[Any]] = cells
         self.is_binary = is_binary
         self._device_arr = None
+        self._host_arr = None
         #: per-(mesh, split) device-sharded copies (parallel engine)
         self._sharded_cache = None
 
@@ -96,6 +107,8 @@ class _ColumnData:
         """The dense column as a device-resident jax array (memoized)."""
         if self.dense is None:
             raise ValueError("only dense columns have a device form")
+        if _is_device_array(self.dense):
+            return self.dense
         if self._device_arr is None or (
             self._device_arr.dtype != self.dense.dtype
         ):
@@ -103,6 +116,17 @@ class _ColumnData:
 
             self._device_arr = jax.device_put(self.dense)
         return self._device_arr
+
+    def host(self) -> np.ndarray:
+        """The dense column as a host numpy array (memoized; this is the
+        point where a device-resident result synchronizes)."""
+        if self.dense is None:
+            raise ValueError("only dense columns have a host block form")
+        if not _is_device_array(self.dense):
+            return self.dense
+        if self._host_arr is None:
+            self._host_arr = np.asarray(self.dense)
+        return self._host_arr
 
     @property
     def num_rows(self) -> int:
@@ -124,12 +148,12 @@ class _ColumnData:
 
     def cell(self, i: int):
         if self.dense is not None:
-            return self.dense[i]
+            return self.host()[i]
         return self.cells[i]
 
     def iter_cells(self):
         if self.dense is not None:
-            return iter(self.dense)
+            return iter(self.host())
         return iter(self.cells)
 
 
@@ -143,6 +167,12 @@ def _build_column(name: str, data) -> Tuple[_ColumnData, ColumnInfo]:
         # make later in-place mutation silently desync the memoized device
         # copy (and any lazy results) from host data.
         return _ColumnData(dense=np.array(data, order="C")), ColumnInfo(
+            name, st, nesting=data.ndim - 1
+        )
+    if _is_device_array(data):
+        # jax arrays are immutable: keep them device-resident, no copy
+        st = for_numpy_dtype(np.dtype(data.dtype))
+        return _ColumnData(dense=data), ColumnInfo(
             name, st, nesting=data.ndim - 1
         )
     data = list(data)
@@ -358,7 +388,7 @@ class TensorFrame:
         for c in self._info:
             cd = self._columns[c.name]
             if cd.dense is not None and cd.dense.ndim == 1:
-                data[c.name] = cd.dense
+                data[c.name] = cd.host()
             else:
                 data[c.name] = list(cd.iter_cells())
         return pd.DataFrame(data)
@@ -402,9 +432,14 @@ class TensorFrame:
 
         Column storage is shared by derived frames (``select`` etc.), so
         this frees the device buffers for all of them; the next engine op
-        re-transfers on demand. Host data is unaffected."""
+        re-transfers on demand. Host data is unaffected. Device-resident
+        result columns are pulled to the host first so their data survives
+        the release."""
         self._force()
         for cd in self._columns.values():
+            if cd.dense is not None and _is_device_array(cd.dense):
+                cd.dense = cd.host()
+                cd._host_arr = None
             cd._device_arr = None
             cd._sharded_cache = None
         return self

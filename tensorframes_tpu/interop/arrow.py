@@ -54,12 +54,12 @@ def to_arrow(df: TensorFrame):
         if cd.is_binary:
             arrays[c.name] = pa.array(cd.cells, type=pa.binary())
         elif cd.dense is not None and cd.dense.ndim == 1:
-            arrays[c.name] = pa.array(cd.dense)
+            arrays[c.name] = pa.array(cd.host())
         elif cd.dense is not None and cd.dense.ndim == 2:
             # uniform vector column: one flat buffer, no Python loop
-            flat = pa.array(np.ascontiguousarray(cd.dense).reshape(-1))
+            flat = pa.array(np.ascontiguousarray(cd.host()).reshape(-1))
             arrays[c.name] = pa.FixedSizeListArray.from_arrays(
-                flat, cd.dense.shape[1]
+                flat, cd.host().shape[1]
             )
         else:
             arrays[c.name] = pa.array(

@@ -1,0 +1,99 @@
+"""Device-resident result columns and the HBM-bound streaming fallback.
+
+map_blocks results stay in HBM so chained ops never round-trip through the
+host (the reference re-marshals rows through JNI on every Session.run,
+``TFDataOps.scala:27-59``) — unless keeping them resident would blow the
+``device_cache_bytes`` budget, in which case each partition's output is
+pulled to host as it lands, keeping peak HBM at ~one block.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.frame.table import _is_device_array
+from tensorframes_tpu.utils import get_config, set_config
+
+
+@pytest.fixture
+def small_budget():
+    prev = get_config().device_cache_bytes
+    set_config(device_cache_bytes=1024)
+    yield
+    set_config(device_cache_bytes=prev)
+
+
+def test_map_blocks_output_is_device_resident():
+    df = tft.TensorFrame.from_columns(
+        {"x": np.arange(32, dtype=np.float32)}, num_partitions=4
+    )
+    out = tft.map_blocks(lambda x: {"z": x * 2.0}, df)
+    cd = out.column_data("z")
+    assert _is_device_array(cd.dense)
+    # host access materializes once and memoizes
+    h1 = cd.host()
+    h2 = cd.host()
+    assert h1 is h2
+    np.testing.assert_allclose(h1, np.arange(32, dtype=np.float32) * 2.0)
+
+
+def test_chained_maps_feed_device_resident_columns():
+    df = tft.TensorFrame.from_columns({"x": np.arange(16, dtype=np.float32)})
+    m1 = tft.map_blocks(lambda x: {"a": x + 1.0}, df)
+    m2 = tft.map_blocks(lambda a: {"b": a * 3.0}, m1)
+    cd = m2.column_data("b")
+    assert _is_device_array(cd.dense)
+    np.testing.assert_allclose(
+        cd.host(), (np.arange(16, dtype=np.float32) + 1.0) * 3.0
+    )
+
+
+def test_streaming_budget_keeps_outputs_on_host(small_budget):
+    # 64 f64 rows x 8 = 4KB > 1KB budget: inputs stream, outputs must land
+    # on host per partition instead of accumulating in device memory
+    x = np.arange(512, dtype=np.float64).reshape(64, 8)
+    df = tft.TensorFrame.from_columns({"x": x}, num_partitions=4)
+    out = tft.map_blocks(lambda x: {"z": x + 1.0}, df)
+    cd = out.column_data("z")
+    assert isinstance(cd.dense, np.ndarray)
+    np.testing.assert_allclose(cd.dense, x + 1.0)
+
+
+def test_large_output_small_input_streams(small_budget):
+    # input fits the budget, but the output is bigger than it: the output
+    # estimate must force host streaming too
+    x = np.arange(64, dtype=np.float32)  # 256B < 1KB
+    df = tft.TensorFrame.from_columns({"x": x}, num_partitions=2)
+    out = tft.map_blocks(
+        lambda x: {"z": np.ones((1, 16), np.float32) * x[:, None]}, df
+    )  # 64*16*4 = 4KB > 1KB
+    cd = out.column_data("z")
+    assert isinstance(cd.dense, np.ndarray)
+    np.testing.assert_allclose(cd.dense[3], np.full(16, 3.0))
+
+
+def test_from_columns_accepts_device_arrays():
+    import jax.numpy as jnp
+
+    arr = jnp.arange(8, dtype=jnp.float32)
+    df = tft.TensorFrame.from_columns({"x": arr})
+    assert _is_device_array(df.column_data("x").dense)
+    assert [r.x for r in df.collect()] == list(range(8))
+
+
+def test_unpersist_preserves_device_resident_results():
+    df = tft.TensorFrame.from_columns({"x": np.arange(8, dtype=np.float32)})
+    out = tft.map_blocks(lambda x: {"z": x * 2.0}, df).cache()
+    out.unpersist_device()
+    cd = out.column_data("z")
+    assert isinstance(cd.dense, np.ndarray)
+    np.testing.assert_allclose(cd.dense, np.arange(8) * 2.0)
+
+
+def test_trim_multi_fetch_row_count_mismatch_raises():
+    df = tft.TensorFrame.from_columns({"x": np.arange(10, dtype=np.float32)})
+    bad = tft.map_blocks(
+        lambda x: {"u": x[:2], "v": x[:3]}, df, trim=True
+    )
+    with pytest.raises(ValueError, match="disagree on the output row count"):
+        bad.cache()
