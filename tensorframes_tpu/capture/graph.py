@@ -226,8 +226,19 @@ class CapturedGraph:
         on the driver per call."""
         import jax
 
+        specs = []
+        for ph in self.placeholders.values():
+            shape = (input_shapes or {}).get(ph.name, ph.shape)
+            specs.append(TensorSpec(ph.name, ph.scalar_type, shape))
+        if any(s.scalar_type.is_64bit for s in specs):
+            ensure_x64()
         cache_key = (
             share_lead,
+            # x64 is process-global and flips lazily (ensure_x64), changing
+            # result dtypes for the same inputs — it must key the cache;
+            # read it AFTER the flip above so the entry reflects the state
+            # the trace actually runs under
+            bool(jax.config.jax_enable_x64),
             tuple(
                 sorted((k, v.dims) for k, v in (input_shapes or {}).items())
             ),
@@ -237,13 +248,6 @@ class CapturedGraph:
             cache = self._analyze_cache = {}
         if cache_key in cache:
             return cache[cache_key]
-
-        specs = []
-        for ph in self.placeholders.values():
-            shape = (input_shapes or {}).get(ph.name, ph.shape)
-            specs.append(TensorSpec(ph.name, ph.scalar_type, shape))
-        if any(s.scalar_type.is_64bit for s in specs):
-            ensure_x64()
         try:
             shapes = _symbolic_shapes(specs, share_lead)
             feed = {

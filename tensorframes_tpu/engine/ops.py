@@ -330,6 +330,7 @@ def map_blocks(
         # output itself would blow the device-cache budget, pull each
         # partition's result to host as it lands (the pre-device-residency
         # behavior), keeping peak HBM at ~one block.
+        budget = get_config().device_cache_bytes
         if not streaming and not trim:
             est = 0
             for spec in out_specs.values():
@@ -338,7 +339,11 @@ def map_blocks(
                     est += (
                         int(np.prod(cell.dims)) if cell.dims else 1
                     ) * spec.scalar_type.np_dtype.itemsize * parent.num_rows
-            streaming = est > get_config().device_cache_bytes
+            streaming = est > budget
+        # trim maps and Unknown-dim fetches have no static size estimate:
+        # track actual accumulated bytes and demote to host streaming the
+        # moment the budget is crossed mid-run
+        acc_bytes = 0
         for p in range(parent.num_partitions):
             lo, hi = parent.partition_bounds()[p]
             n = hi - lo
@@ -368,6 +373,12 @@ def map_blocks(
                         f"produced {out_n}"
                     )
                 out_n = arr.shape[0]
+                if not streaming:
+                    acc_bytes += arr.nbytes
+                    if acc_bytes > budget:
+                        streaming = True
+                        for nm in fetch_names:  # demote what's accumulated
+                            pieces[nm] = [np.asarray(a) for a in pieces[nm]]
                 pieces[name].append(np.asarray(arr) if streaming else arr)
             part_sizes.append(out_n if trim else n)
         cols: Dict[str, _ColumnData] = {}
@@ -399,6 +410,84 @@ def map_blocks(
 # ---------------------------------------------------------------------------
 # map_rows
 # ---------------------------------------------------------------------------
+
+
+def _map_rows_thunk(
+    parent: TensorFrame,
+    binding: Dict[str, str],
+    fetch_names: Sequence[str],
+    out_specs: Dict[str, TensorSpec],
+    result_info: FrameInfo,
+    run_bucket: Callable[[Dict[str, np.ndarray], int], Dict[str, Any]],
+    result_partitions: Optional[int] = None,
+):
+    """Shared row-map execution: bucket rows by input cell shape, assemble
+    each bucket's batched feed (dense gather / ragged gather-pad / stack),
+    run it through ``run_bucket(feed, m) -> {fetch: [m, ...] array}``, and
+    scatter results back into row order. Used by both the local engine
+    (vmap per bucket) and the distributed engine (shard_map-of-vmap with a
+    main+tail split) so bucketing/ragged semantics cannot diverge."""
+
+    def thunk() -> TensorFrame:
+        from ..data import RaggedBuffer, gather_rows
+
+        n = parent.num_rows
+        if n == 0:
+            cols = {
+                name: _ColumnData(
+                    dense=_empty_output(out_specs[name], block_output=False)
+                )
+                for name in fetch_names
+            }
+            for c in parent.schema:
+                cols[c.name] = parent.column_data(c.name)
+            return TensorFrame(cols, result_info)
+        col_data = {ph: parent.column_data(col) for ph, col in binding.items()}
+        # bucket rows by the tuple of input cell shapes (one compiled
+        # program per bucket shape; the jit cache handles specialization)
+        buckets: Dict[Tuple, List[int]] = {}
+        for i in range(n):
+            key = tuple(col_data[ph].cell(i).shape for ph in binding)
+            buckets.setdefault(key, []).append(i)
+        # ragged 1-D columns pack once into (flat, offsets) so bucket
+        # stacking is a native gather instead of a Python stack loop
+        ragged_bufs: Dict[str, RaggedBuffer] = {}
+        for ph, cd in col_data.items():
+            if cd.dense is None and cd.cells[0].ndim == 1:
+                ragged_bufs[ph] = RaggedBuffer.from_cells(cd.cells)
+        out_cells: Dict[str, List] = {name: [None] * n for name in fetch_names}
+        for _, idxs in buckets.items():
+            idx_arr = np.asarray(idxs, dtype=np.int64)
+            feed = {}
+            for ph in binding:
+                cd = col_data[ph]
+                if cd.dense is not None:
+                    feed[ph] = gather_rows(cd.host(), idx_arr)
+                elif ph in ragged_bufs:
+                    feed[ph] = ragged_bufs[ph].gather_pad(idx_arr)
+                else:
+                    feed[ph] = np.stack([cd.cell(i) for i in idxs])
+            res = run_bucket(feed, len(idxs))
+            for name in fetch_names:
+                arr = np.asarray(res[name])
+                for j, i in enumerate(idxs):
+                    out_cells[name][i] = arr[j]
+        cols: Dict[str, _ColumnData] = {}
+        for name in fetch_names:
+            cd, _ = _build_column(name, out_cells[name])
+            cols[name] = cd
+        for c in parent.schema:
+            cols[c.name] = parent.column_data(c.name)
+        if result_partitions is not None:
+            return TensorFrame(
+                cols, result_info, num_partitions=result_partitions
+            )
+        offsets = np.array(
+            [lo for lo, _ in parent.partition_bounds()] + [n], dtype=np.int64
+        )
+        return TensorFrame(cols, result_info, offsets=offsets)
+
+    return thunk
 
 
 def map_rows(
@@ -454,70 +543,59 @@ def map_rows(
     result_info = FrameInfo(fetch_infos + list(dframe.schema))
     parent = dframe
 
-    def thunk() -> TensorFrame:
-        n = parent.num_rows
-        if n == 0:
-            cols = {
-                name: _ColumnData(
-                    dense=_empty_output(out_specs[name], block_output=False)
-                )
-                for name in fetch_names
+    if host_mode:
+
+        def thunk() -> TensorFrame:
+            n = parent.num_rows
+            if n == 0:
+                cols = {
+                    name: _ColumnData(
+                        dense=_empty_output(
+                            out_specs[name], block_output=False
+                        )
+                    )
+                    for name in fetch_names
+                }
+                for c in parent.schema:
+                    cols[c.name] = parent.column_data(c.name)
+                return TensorFrame(cols, result_info)
+            col_data = {
+                ph: parent.column_data(col) for ph, col in binding.items()
             }
-            for c in parent.schema:
-                cols[c.name] = parent.column_data(c.name)
-            return TensorFrame(cols, result_info)
-        col_data = {ph: parent.column_data(col) for ph, col in binding.items()}
-        out_cells: Dict[str, List] = {name: [None] * n for name in fetch_names}
-        if host_mode:
+            out_cells: Dict[str, List] = {
+                name: [None] * n for name in fetch_names
+            }
             for i in range(n):
                 feed = {ph: cd.cell(i) for ph, cd in col_data.items()}
                 res = g.fn(feed)
                 for name in fetch_names:
                     v = res[name]
                     out_cells[name][i] = (
-                        v if isinstance(v, (bytes, bytearray)) else np.asarray(v)
+                        v
+                        if isinstance(v, (bytes, bytearray))
+                        else np.asarray(v)
                     )
-        else:
-            from ..data import RaggedBuffer, gather_rows
+            cols: Dict[str, _ColumnData] = {}
+            for name in fetch_names:
+                cd, _ = _build_column(name, out_cells[name])
+                cols[name] = cd
+            for c in parent.schema:
+                cols[c.name] = parent.column_data(c.name)
+            offsets = np.array(
+                [lo for lo, _ in parent.partition_bounds()] + [n],
+                dtype=np.int64,
+            )
+            return TensorFrame(cols, result_info, offsets=offsets)
 
-            # bucket rows by the tuple of input cell shapes
-            buckets: Dict[Tuple, List[int]] = {}
-            for i in range(n):
-                key = tuple(col_data[ph].cell(i).shape for ph in binding)
-                buckets.setdefault(key, []).append(i)
-            # ragged 1-D columns pack once into (flat, offsets) so bucket
-            # stacking is a native gather instead of a Python stack loop
-            ragged_bufs: Dict[str, RaggedBuffer] = {}
-            for ph, cd in col_data.items():
-                if cd.dense is None and cd.cells[0].ndim == 1:
-                    ragged_bufs[ph] = RaggedBuffer.from_cells(cd.cells)
-            vfn = _jitted_vmap(g)
-            for key, idxs in buckets.items():
-                idx_arr = np.asarray(idxs, dtype=np.int64)
-                feed = {}
-                for ph in binding:
-                    cd = col_data[ph]
-                    if cd.dense is not None:
-                        feed[ph] = gather_rows(cd.host(), idx_arr)
-                    elif ph in ragged_bufs:
-                        feed[ph] = ragged_bufs[ph].gather_pad(idx_arr)
-                    else:
-                        feed[ph] = np.stack([cd.cell(i) for i in idxs])
-                res = vfn(feed)
-                for name in fetch_names:
-                    arr = np.asarray(res[name])
-                    for j, i in enumerate(idxs):
-                        out_cells[name][i] = arr[j]
-        cols: Dict[str, _ColumnData] = {}
-        for name in fetch_names:
-            cd, _ = _build_column(name, out_cells[name])
-            cols[name] = cd
-        for c in parent.schema:
-            cols[c.name] = parent.column_data(c.name)
-        offsets = np.array(
-            [lo for lo, _ in parent.partition_bounds()] + [n], dtype=np.int64
+    else:
+        thunk = _map_rows_thunk(
+            parent,
+            binding,
+            fetch_names,
+            out_specs,
+            result_info,
+            run_bucket=lambda feed, m: _jitted_vmap(g)(feed),
         )
-        return TensorFrame(cols, result_info, offsets=offsets)
 
     return TensorFrame(
         {}, result_info, num_partitions=parent.num_partitions, _thunk=thunk

@@ -5,7 +5,7 @@ funnel, SURVEY §2.5) with ``shard_map`` programs and XLA collectives.
 """
 
 from .mesh import make_mesh, default_mesh, data_axis
-from .distributed import map_blocks, reduce_blocks, reduce_rows, aggregate
+from .distributed import map_blocks, map_rows, reduce_blocks, reduce_rows, aggregate
 from .training import ShardedSGDTrainer
 
 __all__ = [
@@ -13,6 +13,7 @@ __all__ = [
     "default_mesh",
     "data_axis",
     "map_blocks",
+    "map_rows",
     "reduce_blocks",
     "reduce_rows",
     "aggregate",

@@ -47,6 +47,8 @@ from ..engine.ops import (
     _ensure_precision,
     _fetch_column_info,
     _jitted,
+    _jitted_vmap,
+    _map_rows_thunk,
     _unpack_reduce_result,
 )
 from ..engine import aggregate as _local_aggregate
@@ -62,7 +64,7 @@ from ..schema import FrameInfo, Shape, Unknown
 from ..utils import get_config, get_logger
 from .mesh import DATA_AXIS, default_mesh
 
-__all__ = ["map_blocks", "reduce_blocks", "reduce_rows", "aggregate"]
+__all__ = ["map_blocks", "map_rows", "reduce_blocks", "reduce_rows", "aggregate"]
 
 logger = get_logger("parallel")
 
@@ -273,6 +275,86 @@ def map_blocks(
             cols[c.name] = parent.column_data(c.name)
         return TensorFrame(cols, result_info, num_partitions=ndev)
 
+    return TensorFrame({}, result_info, num_partitions=ndev, _thunk=thunk)
+
+
+# ---------------------------------------------------------------------------
+# map_rows
+# ---------------------------------------------------------------------------
+
+
+def map_rows(
+    fetches,
+    dframe: TensorFrame,
+    mesh=None,
+    feed_dict: Optional[Dict[str, str]] = None,
+) -> TensorFrame:
+    """Distributed row-wise map: rows are bucketed by input cell shape (as in
+    the local engine), and each bucket runs as one ``shard_map``-of-``vmap``
+    program with rows sharded over the ``dp`` axis — every chip maps its
+    slice of the bucket concurrently. Ragged 1-D columns pack into
+    (flat, offsets) buffers and feed buckets via a native gather-pad. The
+    distributed analog of the reference's per-task row loop
+    (``performMapRows``, ``DebugRowOps.scala:396-477,819-857``).
+
+    Binary (host-path) programs have no device program to shard; they
+    delegate to the local engine, same as the reference runs them inside an
+    ordinary task."""
+    import jax
+
+    mesh = _mesh_or_default(mesh)
+    g = _as_graph(fetches, dframe, cell_inputs=True, feed_dict=feed_dict)
+    binding = validate_map_inputs(g, dframe.schema, block=False)
+    host_mode = any(
+        dframe.schema[col].scalar_type.name == "binary"
+        for col in binding.values()
+    )
+    if host_mode:
+        from ..engine import map_rows as local_map_rows
+
+        return local_map_rows(g, dframe)  # feed_dict already merged into g
+    _ensure_precision(g, dframe.schema)
+    input_shapes = {
+        ph: dframe.schema[col].cell_shape for ph, col in binding.items()
+    }
+    out_specs = g.analyze(input_shapes, share_lead=False)
+    check_output_collisions(out_specs, dframe.schema)
+    fetch_names = sorted(out_specs)
+    fetch_infos = [
+        _fetch_column_info(n, out_specs[n], block_output=False)
+        for n in fetch_names
+    ]
+    result_info = FrameInfo(fetch_infos + list(dframe.schema))
+    ndev = _dp_size(mesh)
+    parent = dframe
+
+    def run_bucket(feed: Dict[str, Any], m: int) -> Dict[str, Any]:
+        """Sharded main region + local tail, concatenated per fetch."""
+        main, tail = _split(m, ndev)
+        parts = []
+        if main:
+            vprog = _shard_mapped(g, mesh, jax.vmap(g.fn), kind="map_rows")
+            parts.append(vprog({ph: feed[ph][:main] for ph in binding}))
+        if tail:
+            parts.append(
+                _jitted_vmap(g)({ph: feed[ph][main:] for ph in binding})
+            )
+        if len(parts) == 1:
+            return parts[0]
+        return {
+            f: np.concatenate([np.asarray(r[f]) for r in parts])
+            for f in fetch_names
+        }
+
+    thunk = _map_rows_thunk(
+        parent,
+        binding,
+        fetch_names,
+        out_specs,
+        result_info,
+        run_bucket=run_bucket,
+        result_partitions=ndev,
+    )
     return TensorFrame({}, result_info, num_partitions=ndev, _thunk=thunk)
 
 

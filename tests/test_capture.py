@@ -226,3 +226,41 @@ class TestSerialize:
     def test_bad_magic(self):
         with pytest.raises(ValueError, match="serialized graph"):
             cap.deserialize_graph(b"garbage")
+
+
+def test_analyze_cache_keys_on_x64_state():
+    """The analyze memo must not serve a pre-x64 spec after ensure_x64
+    flips result dtypes (x64 is one-way in-process, so this needs a fresh
+    interpreter)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import numpy as np\n"
+        "from tensorframes_tpu.capture import CapturedGraph\n"
+        "from tensorframes_tpu.schema import for_numpy_dtype, Shape, Unknown\n"
+        "def fn(x):\n"
+        "    return {'z': x.astype('float64') + 1}\n"
+        "g = CapturedGraph.from_callable(\n"
+        "    fn, {'x': (for_numpy_dtype(np.dtype('float32')), Shape([Unknown]))})\n"
+        "s1 = g.analyze({'x': Shape([Unknown])})\n"
+        "assert s1['z'].scalar_type.name == 'float32', s1  # x64 off: demoted\n"
+        "from tensorframes_tpu.utils import ensure_x64\n"
+        "ensure_x64()\n"
+        "s2 = g.analyze({'x': Shape([Unknown])})\n"
+        "assert s2['z'].scalar_type.name == 'float64', s2  # not the stale memo\n"
+        "print('x64-keyed OK')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "x64-keyed OK" in res.stdout
