@@ -720,6 +720,128 @@ def reduce_rows(fetches, dframe: TensorFrame):
 # aggregate
 # ---------------------------------------------------------------------------
 
+#: rows per chunk in the large-frame aggregate path: the segmented scan's
+#: compile time grows with log2(rows scanned), so large frames are scanned
+#: as [m, _AGG_CHUNK] with vmap (fixed depth, one compile per cell shape)
+#: and per-chunk boundary partials merged by a recursive final pass
+_AGG_CHUNK = 8192
+
+
+def _group_sort(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple:
+    """Group-key machinery shared by the local and distributed aggregates.
+
+    Supports numeric scalar keys, binary (bytes/string) keys, and
+    multi-column combinations of both — the reference aggregates under any
+    Spark ``groupBy`` key, strings included (``DebugRowOps.scala:547-592``,
+    ``core_test.py:213-222``).
+
+    The sort itself runs ON DEVICE (stable argsort over the key column, or
+    over host-computed integer codes), so host work is at most the O(n)
+    dict-coding pass for binary/multi keys; for a single numeric key the
+    host does no per-row work at all.
+
+    Returns ``(order, flags, emit_keys)``:
+
+    - ``order``: DEVICE int row permutation grouping equal keys (stays on
+      device — for large frames it is tens of MB that the feed gather
+      consumes in HBM anyway),
+    - ``flags``: host bool segment-start marks over the sorted rows,
+    - ``emit_keys(ends) -> dict[name, column_data]``: the representative key
+      value per group, given sorted-row segment-end indices.
+    """
+    import jax.numpy as jnp
+
+    n = dframe.num_rows
+    key_cds = []
+    for k in keys:
+        kd = dframe.column_data(k)
+        if kd.dense is None and not kd.is_binary:
+            raise ValueError(
+                f"grouping column {k!r} is ragged; group keys must be "
+                f"scalars or binary cells"
+            )
+        if kd.dense is not None and kd.dense.ndim != 1:
+            raise ValueError(
+                f"grouping column {k!r} must hold scalar cells to group by"
+            )
+        if k in binding.values():
+            raise ValueError(f"column {k!r} cannot be both key and input")
+        key_cds.append(kd)
+
+    if all(kd.dense is not None for kd in key_cds):
+        # pure numeric: device lexsort via repeated stable argsort
+        # (last key first), flags from adjacent inequality on device
+        order_dev = None
+        for kd in reversed(key_cds):
+            kv = kd.device()
+            if order_dev is None:
+                order_dev = jnp.argsort(kv, stable=True)
+            else:
+                order_dev = order_dev[
+                    jnp.argsort(kv[order_dev], stable=True)
+                ]
+        sorted_keys = [kd.device()[order_dev] for kd in key_cds]
+        neq = None
+        for sk in sorted_keys:
+            d = sk[1:] != sk[:-1]
+            neq = d if neq is None else (neq | d)
+        flags = np.concatenate([[True], np.asarray(neq)])
+        order = order_dev  # device-resident; no host round trip
+
+        def emit_keys(ends):
+            ends_dev = jnp.asarray(np.asarray(ends))
+            return {
+                k: sk[ends_dev] for k, sk in zip(keys, sorted_keys)
+            }
+
+    else:
+        # binary or mixed keys: one O(n) host pass assigns integer codes by
+        # first appearance; the sort over codes still runs on device
+        cols = [
+            kd.cells if kd.is_binary else kd.host() for kd in key_cds
+        ]
+        mapping: Dict[Any, int] = {}
+        codes = np.empty(n, dtype=np.int64)
+        single = len(cols) == 1
+        for i in range(n):
+            kv = cols[0][i] if single else tuple(
+                bytes(c[i]) if isinstance(c[i], (bytes, bytearray))
+                else c[i].item()
+                for c in cols
+            )
+            if isinstance(kv, (bytes, bytearray)):
+                kv = bytes(kv)
+            elif isinstance(kv, np.generic):
+                kv = kv.item()
+            code = mapping.get(kv)
+            if code is None:
+                code = mapping[kv] = len(mapping)
+            codes[i] = code
+        codes_dev = jnp.asarray(codes)
+        order_dev = jnp.argsort(codes_dev, stable=True)
+        sorted_c = codes_dev[order_dev]
+        flags = np.concatenate(
+            [[True], np.asarray(sorted_c[1:] != sorted_c[:-1])]
+        )
+        order = order_dev  # device-resident, same as the numeric path
+        order_host_box: List[Optional[np.ndarray]] = [None]
+
+        def emit_keys(ends):
+            # key cells live on the host; pull the permutation over once,
+            # lazily, only for this gather
+            if order_host_box[0] is None:
+                order_host_box[0] = np.asarray(order_dev)
+            rows = order_host_box[0][np.asarray(ends)]
+            out = {}
+            for k, kd in zip(keys, key_cds):
+                if kd.is_binary:
+                    out[k] = [kd.cells[i] for i in rows]
+                else:
+                    out[k] = kd.host()[rows]
+            return out
+
+    return order, flags, emit_keys
+
 
 def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
     """Keyed algebraic aggregation (``core.py:377-395``): for grouped data,
@@ -730,14 +852,17 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
 
     1. per-row partials: the reduce graph runs on blocks of 1 via ``vmap``
        (one program, any row count);
-    2. rows sorted by group key on the host (cheap integer argsort);
+    2. rows sorted by group key ON DEVICE (stable argsort; binary/mixed
+       keys get O(n) host dict-coding first — see :func:`_group_sort`);
     3. one *segmented associative scan* on device combines partials within
        segments — ``combine((a,fa),(b,fb)) = (fb ? b : merge(a,b), fa|fb)``
        where ``merge`` stacks two partials and re-applies the reduce graph;
     4. the last scan element of each segment is that group's result.
 
     The merge is assumed associative, same as the reference ("algebraic
-    aggregation", ``Operations.scala:110-120``).
+    aggregation", ``Operations.scala:110-120``). Keys may be numeric
+    scalars, binary cells, or multi-column mixes (reference
+    ``DebugRowOps.scala:547-592``).
     """
     dframe = grouped_data.frame
     keys = grouped_data.keys
@@ -745,12 +870,6 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
         raise ValueError("aggregate requires at least one grouping column")
     g = _as_graph(fetches, dframe, cell_inputs=False)
     binding = validate_reduce_block_graph(g, dframe.schema)
-    for k in keys:
-        kd = dframe.column_data(k)
-        if kd.dense is None or kd.dense.ndim != 1:
-            raise ValueError(f"grouping column {k!r} must be dense scalars")
-        if k in binding.values():
-            raise ValueError(f"column {k!r} cannot be both key and input")
     _ensure_precision(g, dframe.schema)
     import jax
     import jax.numpy as jnp
@@ -761,19 +880,10 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
     if n == 0:
         raise ValueError("aggregate on an empty frame")
 
-    # -- host: group codes + stable sort by key
-    key_cols = [np.asarray(dframe.column_block(k)) for k in keys]
-    # group identity over multiple key columns via a structured view
-    stacked = np.rec.fromarrays(key_cols)
-    _, codes = np.unique(stacked, return_inverse=True)
-    order = np.argsort(codes, kind="stable")
-    codes_sorted = codes[order]
-    flags = np.empty(n, dtype=bool)
-    flags[0] = True
-    flags[1:] = codes_sorted[1:] != codes_sorted[:-1]
+    order, flags, emit_keys = _group_sort(dframe, keys, binding)
 
-    scan_fn = getattr(g, "_agg_scan_cache", None)
-    if scan_fn is None:
+    progs = getattr(g, "_agg_scan_cache", None)
+    if progs is None:
 
         def merge_pair(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
             feed = {
@@ -783,8 +893,7 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
 
         vmerge = jax.vmap(merge_pair)
 
-        @jax.jit
-        def scan_fn(block_feed: Dict[str, Any], flags_: Any) -> Dict[str, Any]:
+        def scan_body(block_feed: Dict[str, Any], flags_: Any) -> Dict[str, Any]:
             # per-row partials: reduce graph applied to blocks of one row
             per_row = jax.vmap(
                 lambda cells: g.fn(
@@ -807,17 +916,20 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
             scanned, _ = lax.associative_scan(combine, (per_row, flags_), axis=0)
             return scanned
 
-        g._agg_scan_cache = scan_fn
+        # plain jit for small frames; vmap-over-chunks for large ones (the
+        # chunked program's scan depth is fixed at log2(_AGG_CHUNK), so
+        # compile time stops growing with the frame)
+        progs = (jax.jit(scan_body), jax.jit(jax.vmap(scan_body)))
+        g._agg_scan_cache = progs
+    scan_fn, chunked_fn = progs
 
-    from ..data import gather_rows
-
+    # feed gather happens on device: column -> HBM once (memoized), then a
+    # device gather by the sorted order — the host never touches the values
+    order_dev = jnp.asarray(order)
     sorted_feed = {
-        f: gather_rows(np.asarray(dframe.column_block(col)), order)
+        f: dframe.column_data(col).device()[order_dev]
         for f, col in binding.items()
     }
-    scanned = scan_fn(sorted_feed, flags)
-    # last row of each segment holds that group's reduce
-    ends = np.append(np.nonzero(flags[1:])[0], n - 1)
 
     out_specs = g.analyze(
         {
@@ -825,14 +937,59 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
             for f, col in binding.items()
         }
     )
+
+    if n > _AGG_CHUNK:
+        # -- chunked path: pad to a multiple of the chunk, force a segment
+        # restart at every chunk boundary, scan all chunks in parallel with
+        # one fixed-depth program, then merge the boundary partials by
+        # recursing on the (tiny) per-chunk-per-group partial table — the
+        # same partial/final shape as the distributed engine's shard merge.
+        m = -(-n // _AGG_CHUNK)
+        n_pad = m * _AGG_CHUNK
+        flags_p = np.zeros(n_pad, dtype=bool)
+        flags_p[:n] = flags
+        flags_p[np.arange(m) * _AGG_CHUNK] = True
+        if n_pad > n:
+            flags_p[n] = True  # padding forms its own garbage segment
+        starts = np.nonzero(flags_p[:n])[0]
+        ends = np.append(starts[1:] - 1, n - 1)
+        if len(ends) > n // 2:
+            # nearly-unique keys: the partial table cannot shrink enough for
+            # the recursion to make progress (equal-size recursion would
+            # never terminate), so scan the whole frame in one log2(n)-depth
+            # program instead — slower to compile, but correct at any group
+            # count
+            ends = None
+    else:
+        ends = None
+
+    if ends is not None:
+        feed_r = {}
+        for f, arr in sorted_feed.items():
+            pad_width = [(0, n_pad - n)] + [(0, 0)] * (arr.ndim - 1)
+            padded = jnp.pad(arr, pad_width)
+            feed_r[f] = padded.reshape((m, _AGG_CHUNK) + arr.shape[1:])
+        scanned = chunked_fn(feed_r, flags_p.reshape(m, _AGG_CHUNK))
+        ci = jnp.asarray(ends // _AGG_CHUNK)
+        co = jnp.asarray(ends % _AGG_CHUNK)
+        partial_cols: Dict[str, Any] = dict(emit_keys(ends))
+        for f in fetch_names:
+            partial_cols[f] = scanned[f][ci, co]  # device gather, #partials rows
+        partials = TensorFrame.from_columns(partial_cols).analyze()
+        g2 = g.with_inputs({f"{f}_input": f for f in fetch_names})
+        return aggregate(g2, GroupedFrame(partials, keys))
+
+    scanned = scan_fn(sorted_feed, flags)
+    # last row of each segment holds that group's reduce
+    ends = np.append(np.nonzero(flags[1:])[0], n - 1)
     cols: Dict[str, _ColumnData] = {}
     infos: List[ColumnInfo] = []
-    for k, kc in zip(keys, key_cols):
-        cols[k] = _ColumnData(dense=np.ascontiguousarray(kc[order][ends]))
+    for k, kdata in emit_keys(ends).items():
+        cd, _ = _build_column(k, kdata)
+        cols[k] = cd
         infos.append(dframe.schema[k])
     for f in fetch_names:
-        arr = np.asarray(scanned[f])[ends]
-        cols[f] = _ColumnData(dense=np.ascontiguousarray(arr))
+        cols[f] = _ColumnData(dense=scanned[f][jnp.asarray(ends)])
         infos.append(_fetch_column_info(f, out_specs[f], block_output=False))
     return TensorFrame(cols, FrameInfo(infos))
 

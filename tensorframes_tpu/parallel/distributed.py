@@ -557,26 +557,15 @@ def aggregate(
 
     g = _as_graph(fetches, df, cell_inputs=False)
     binding = validate_reduce_block_graph(g, df.schema)
-    for k in keys:
-        kd = df.column_data(k)
-        if kd.dense is None or kd.dense.ndim != 1:
-            raise ValueError(f"grouping column {k!r} must be dense scalars")
-        if k in binding.values():
-            raise ValueError(f"column {k!r} cannot be both key and input")
     _ensure_precision(g, df.schema)
     fetch_names = list(g.fetch_names)
 
-    # host: global key sort; main/tail split for non-divisible row counts
-    key_cols = [np.asarray(df.column_block(k)) for k in keys]
-    stacked = np.rec.fromarrays(key_cols)
-    _, codes = np.unique(stacked, return_inverse=True)
-    order = np.argsort(codes, kind="stable")
-    codes_sorted = codes[order]
-    main, tail = _split(n, ndev)
+    # global key sort on device (binary/mixed keys dict-code on host first);
+    # main/tail split for non-divisible row counts
+    from ..engine.ops import _group_sort
 
-    flags = np.empty(n, dtype=bool)
-    flags[0] = True
-    flags[1:] = codes_sorted[1:] != codes_sorted[:-1]
+    order, flags, emit_keys = _group_sort(df, keys, binding)
+    main, tail = _split(n, ndev)
     # each shard's scan restarts: force a segment start at shard boundaries
     shard_rows = main // ndev
     flags[np.arange(1, ndev) * shard_rows] = True
@@ -610,12 +599,22 @@ def aggregate(
         scanned, _ = lax.associative_scan(combine, (per_row, flags_), axis=0)
         return scanned
 
-    from ..data import gather_rows
+    import jax.numpy as jnp
 
+    # feed gather on device: memoized HBM column + device gather by order
+    order_dev = jnp.asarray(order)
     sorted_feed = {
-        f: gather_rows(np.asarray(df.column_block(col)), order)
+        f: df.column_data(col).device()[order_dev]
         for f, col in binding.items()
     }
+    # segment ends (known before the scan runs — flags are host bools):
+    # last row before each segment start, plus the final row. Gathering the
+    # per-group rows ON DEVICE means only #groups rows cross to the host,
+    # not the full n-row scan output.
+    starts = np.nonzero(flags)[0]
+    ends = np.append(starts[1:] - 1, n - 1)
+    ends_main = ends[ends < main]
+    ends_tail = ends[ends >= main] - main
     pieces: Dict[str, List[np.ndarray]] = {f: [] for f in fetch_names}
     if main:
         sharded_scan = _cached_program(
@@ -636,8 +635,9 @@ def aggregate(
         scanned = sharded_scan(
             {f: a[:main] for f, a in sorted_feed.items()}, flags[:main]
         )
+        em = jnp.asarray(ends_main)
         for f in fetch_names:
-            pieces[f].append(np.asarray(scanned[f]))
+            pieces[f].append(np.asarray(scanned[f][em]))
     if tail:
         tail_scan = _cached_program(
             g, "aggregate_tail", lambda: jax.jit(scan_body)
@@ -645,18 +645,16 @@ def aggregate(
         scanned = tail_scan(
             {f: a[main:] for f, a in sorted_feed.items()}, flags[main:]
         )
+        et = jnp.asarray(ends_tail)
         for f in fetch_names:
-            pieces[f].append(np.asarray(scanned[f]))
-    scanned_all = {f: np.concatenate(pieces[f], axis=0) for f in fetch_names}
+            pieces[f].append(np.asarray(scanned[f][et]))
 
-    # segment ends: last row before each segment start, plus the final row
-    starts = np.nonzero(flags)[0]
-    ends = np.append(starts[1:] - 1, n - 1)
-    partial_cols: Dict[str, Any] = {}
-    for k, kc in zip(keys, key_cols):
-        partial_cols[k] = np.ascontiguousarray(kc[order][ends])
+    partial_cols: Dict[str, Any] = dict(emit_keys(ends))
     for f in fetch_names:
-        partial_cols[f] = np.ascontiguousarray(scanned_all[f][ends])
+        ps = pieces[f]
+        partial_cols[f] = (
+            ps[0] if len(ps) == 1 else np.concatenate(ps, axis=0)
+        )
     partials = TensorFrame.from_columns(partial_cols).analyze()
     # partial value columns are named after the fetches; rebind the merge
     # graph's f_input placeholders to them and fold boundary duplicates

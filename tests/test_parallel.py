@@ -240,3 +240,43 @@ class TestDistributedMapRows:
             lambda blob: {"n": np.float64(len(blob))}, df, mesh=mesh
         )
         assert [r.n for r in out.collect()] == [float(i + 1) for i in range(10)]
+
+
+class TestDistributedAggregateGeneralKeys:
+    def test_binary_key_matches_local(self, mesh):
+        rng = np.random.default_rng(3)
+        names = [b"a", b"bb", b"ccc", b"dddd"]
+        rows = [
+            {"name": names[int(i)], "x": float(v)}
+            for i, v in zip(rng.integers(0, 4, 50), rng.normal(size=50))
+        ]
+        df = tft.TensorFrame.from_rows(rows)
+        dist = par.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)},
+            df.group_by("name"),
+            mesh=mesh,
+        )
+        local = tft.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)}, df.group_by("name")
+        )
+        d = sorted((r.name, round(r.x, 6)) for r in dist.collect())
+        l = sorted((r.name, round(r.x, 6)) for r in local.collect())
+        assert d == l
+
+    def test_mixed_multi_key(self, mesh):
+        rows = [
+            {"s": [b"x", b"y"][i % 2], "k": np.int64(i % 3), "v": float(i)}
+            for i in range(40)
+        ]
+        df = tft.TensorFrame.from_rows(rows)
+        dist = par.aggregate(
+            lambda v_input: {"v": v_input.sum(axis=0)},
+            df.group_by("s", "k"),
+            mesh=mesh,
+        )
+        local = tft.aggregate(
+            lambda v_input: {"v": v_input.sum(axis=0)}, df.group_by("s", "k")
+        )
+        assert sorted((r.s, int(r.k), r.v) for r in dist.collect()) == sorted(
+            (r.s, int(r.k), r.v) for r in local.collect()
+        )
