@@ -931,13 +931,6 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
         for f, col in binding.items()
     }
 
-    out_specs = g.analyze(
-        {
-            f"{f}_input": dframe.schema[col].block_shape.with_lead(Unknown)
-            for f, col in binding.items()
-        }
-    )
-
     if n > _AGG_CHUNK:
         # -- chunked path: pad to a multiple of the chunk, force a segment
         # restart at every chunk boundary, scan all chunks in parallel with
@@ -979,6 +972,12 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
         g2 = g.with_inputs({f"{f}_input": f for f in fetch_names})
         return aggregate(g2, GroupedFrame(partials, keys))
 
+    out_specs = g.analyze(
+        {
+            f"{f}_input": dframe.schema[col].block_shape.with_lead(Unknown)
+            for f, col in binding.items()
+        }
+    )
     scanned = scan_fn(sorted_feed, flags)
     # last row of each segment holds that group's reduce
     ends = np.append(np.nonzero(flags[1:])[0], n - 1)

@@ -31,7 +31,19 @@ def from_arrow(table, num_partitions: int = 1) -> TensorFrame:
                 f"nullable columns are not supported — fill or drop them "
                 f"before ingesting"
             )
-        if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+        if pa.types.is_fixed_size_list(col.type):
+            # the dense-vector fast path to_arrow writes: one flat buffer
+            k = col.type.list_size
+            values = col.flatten()
+            if values.null_count:
+                raise ValueError(
+                    f"Column {name!r} contains {values.null_count} null "
+                    f"element(s) inside its vectors; nullable columns are "
+                    f"not supported — fill or drop them before ingesting"
+                )
+            flat = values.to_numpy(zero_copy_only=False)
+            data[name] = flat.reshape(len(col), k)
+        elif pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
             data[name] = [np.asarray(v) for v in col.to_pylist()]
         elif pa.types.is_binary(col.type) or pa.types.is_string(col.type):
             vals = col.to_pylist()
