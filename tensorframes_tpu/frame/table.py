@@ -279,12 +279,12 @@ class TensorFrame:
     @staticmethod
     def from_arrow(table, num_partitions: int = 1) -> "TensorFrame":
         """Ingest a pyarrow Table (interop edge; reference reads Spark
-        DataFrames, we read Arrow — the common interchange)."""
-        data = {}
-        for name in table.column_names:
-            col = table.column(name)
-            data[name] = col.to_pylist()
-        return TensorFrame.from_columns(data, num_partitions=num_partitions)
+        DataFrames, we read Arrow — the common interchange). Delegates to
+        :func:`tensorframes_tpu.interop.arrow.from_arrow` (null rejection,
+        dense/FixedSizeList fast paths)."""
+        from ..interop.arrow import from_arrow
+
+        return from_arrow(table, num_partitions=num_partitions)
 
     # -- laziness ----------------------------------------------------------
 
@@ -466,6 +466,57 @@ class TensorFrame:
     # alias matching Spark naming
     groupBy = group_by
 
+    # -- method-style op sugar (reference ``DFImplicits``: the Scala DSL
+    # adds df.mapBlocks(...)/df.reduceRows(...) directly on DataFrames,
+    # ``dsl/Implicits.scala:25-116``) --------------------------------------
+
+    def map_blocks(
+        self, fetches, trim: bool = False, feed_dict=None, constants=None
+    ) -> "TensorFrame":
+        from ..engine import map_blocks
+
+        return map_blocks(
+            fetches, self, trim=trim, feed_dict=feed_dict, constants=constants
+        )
+
+    def map_rows(self, fetches, feed_dict=None) -> "TensorFrame":
+        from ..engine import map_rows
+
+        return map_rows(fetches, self, feed_dict=feed_dict)
+
+    def reduce_blocks(self, fetches):
+        from ..engine import reduce_blocks
+
+        return reduce_blocks(fetches, self)
+
+    def reduce_rows(self, fetches):
+        from ..engine import reduce_rows
+
+        return reduce_rows(fetches, self)
+
+    def block(self, col: str, tft_name: Optional[str] = None):
+        """Auto-placeholder from this frame's column metadata (reference
+        ``df.block(col)``, ``dsl/Implicits.scala:89-93``)."""
+        from ..capture import dsl as _dsl
+
+        return _dsl.block(self, col, tft_name=tft_name)
+
+    def row(self, col: str, tft_name: Optional[str] = None):
+        from ..capture import dsl as _dsl
+
+        return _dsl.row(self, col, tft_name=tft_name)
+
+    # camelCase aliases matching the reference DSL surface
+    mapBlocks = map_blocks
+    mapRows = map_rows
+    reduceBlocks = reduce_blocks
+    reduceRows = reduce_rows
+
+    def mapBlocksTrimmed(self, fetches, feed_dict=None, constants=None):
+        return self.map_blocks(
+            fetches, trim=True, feed_dict=feed_dict, constants=constants
+        )
+
     # -- analysis (reference ``tfs.analyze``) ------------------------------
 
     def analyze(self) -> "TensorFrame":
@@ -532,6 +583,14 @@ class GroupedFrame:
     def __init__(self, frame: TensorFrame, keys: List[str]):
         self.frame = frame
         self.keys = keys
+
+    def aggregate(self, fetches) -> TensorFrame:
+        """Method-style aggregate (reference
+        ``RichRelationalGroupedDataset.aggregate``,
+        ``dsl/Implicits.scala:107-116``)."""
+        from ..engine import aggregate
+
+        return aggregate(fetches, self)
 
     def __repr__(self):
         return f"GroupedFrame(keys={self.keys}, frame={self.frame!r})"

@@ -4,6 +4,7 @@ reference (`ExtraOperationsSuite.scala:15-98`)."""
 import numpy as np
 import pytest
 
+import tensorframes_tpu as tft
 from tensorframes_tpu.frame import Row, TensorFrame
 from tensorframes_tpu.schema import Shape, Unknown
 
@@ -141,3 +142,72 @@ def test_filter_rows():
     df = TensorFrame.from_columns({"x": np.arange(5.0)})
     df2 = df.filter_rows(np.array([True, False, True, False, True]))
     assert [r.x for r in df2.collect()] == [0.0, 2.0, 4.0]
+
+
+class TestMethodStyleOps:
+    """Method-style op sugar (reference DFImplicits adds df.mapBlocks(...)
+    etc. on DataFrames, ``dsl/Implicits.scala:25-116``)."""
+
+    def test_map_blocks_method(self):
+        df = tft.TensorFrame.from_columns({"x": np.arange(5.0)})
+        out = df.map_blocks(lambda x: {"z": x + 3.0})
+        assert [r.z for r in out.collect()] == [3.0, 4.0, 5.0, 6.0, 7.0]
+
+    def test_camelcase_aliases_and_trimmed(self):
+        df = tft.TensorFrame.from_columns({"x": np.arange(6.0)})
+        assert df.mapBlocks(lambda x: {"z": x * 2.0}).collect()[2].z == 4.0
+        tr = df.mapBlocksTrimmed(lambda x: {"u": x[:2]})
+        assert len(tr.collect()) == 2
+        assert df.mapRows(lambda x: {"r": x + 1.0}).collect()[0].r == 1.0
+
+    def test_reduce_methods(self):
+        df = tft.TensorFrame.from_columns({"x": np.arange(4.0)})
+        assert float(df.reduce_blocks(lambda x_input: {"x": x_input.sum()})) == 6.0
+        assert float(df.reduceRows(lambda x_1, x_2: {"x": x_1 + x_2})) == 6.0
+
+    def test_block_method_and_dsl(self):
+        df = tft.TensorFrame.from_columns({"x": np.arange(3.0)})
+        with tft.graph():
+            z = (df.block("x") * 2.0).named("z")
+            out = df.map_blocks(z)
+        assert [r.z for r in out.collect()] == [0.0, 2.0, 4.0]
+
+    def test_grouped_aggregate_method(self):
+        df = tft.TensorFrame.from_columns(
+            {
+                "k": np.array([0, 1, 0], dtype=np.int64),
+                "x": np.array([1.0, 2.0, 4.0]),
+            }
+        )
+        out = df.group_by("k").aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)}
+        )
+        assert sorted((int(r.k), r.x) for r in out.collect()) == [
+            (0, 5.0),
+            (1, 2.0),
+        ]
+
+
+class TestFromArrowUnified:
+    def test_fixed_size_list_round_trip_via_class_method(self):
+        pa = pytest.importorskip("pyarrow")
+        from tensorframes_tpu.interop.arrow import to_arrow
+
+        df = tft.TensorFrame.from_columns(
+            {"v": np.arange(8, dtype=np.float32).reshape(4, 2)}
+        ).analyze()
+        table = to_arrow(df)
+        assert pa.types.is_fixed_size_list(table.column("v").type)
+        back = tft.TensorFrame.from_arrow(table)
+        # the fast path must land a dense [n, 2] f32 column, not object cells
+        assert back.column_data("v").host().dtype == np.float32
+        np.testing.assert_array_equal(
+            back.column_data("v").host(), df.column_data("v").host()
+        )
+
+    def test_nulls_rejected_via_class_method(self):
+        pa = pytest.importorskip("pyarrow")
+
+        table = pa.table({"x": pa.array([1.0, None, 3.0])})
+        with pytest.raises(ValueError, match="null"):
+            tft.TensorFrame.from_arrow(table)
