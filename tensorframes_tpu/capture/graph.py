@@ -275,42 +275,49 @@ class CapturedGraph:
         return result
 
     def _concrete_probe(self, specs: Sequence[TensorSpec]):
-        """Fallback when polymorphic tracing fails: trace once with concrete
-        stand-in sizes. Unknown dims are filled with distinct primes so output
-        dims that inherited them can be detected and re-marked Unknown."""
+        """Fallback when polymorphic tracing fails: trace twice with two
+        disjoint sets of large co-prime stand-in sizes for Unknown dims.
+        Output dims that change between the probes inherited an Unknown
+        input dim and are re-marked Unknown; dims that stay put are genuine
+        constants — even if they coincide with a fill value."""
         import jax
 
-        primes = iter([13, 7, 5, 3, 11, 17, 19, 23, 29, 31])
-        lead_fill: Optional[int] = None  # Unknown lead dims share one size
-        fill_values: set = set()
-        feed = {}
-        for s in specs:
-            dims = []
-            for axis, d in enumerate(s.shape.dims):
-                if d != Unknown:
-                    dims.append(d)
-                elif axis == 0:
-                    if lead_fill is None:
-                        lead_fill = next(primes)
-                        fill_values.add(lead_fill)
-                    dims.append(lead_fill)
-                else:
-                    f = next(primes)
-                    fill_values.add(f)
-                    dims.append(f)
-            feed[s.name] = _sds(tuple(dims), s.scalar_type.jax_dtype)
-        out = jax.eval_shape(self.fn, feed)
+        def probe(fills):
+            it = iter(fills)
+            lead_fill: Optional[int] = None  # Unknown lead dims share a size
+            feed = {}
+            for s in specs:
+                dims = []
+                for axis, d in enumerate(s.shape.dims):
+                    if d != Unknown:
+                        dims.append(d)
+                    elif axis == 0:
+                        if lead_fill is None:
+                            lead_fill = next(it)
+                        dims.append(lead_fill)
+                    else:
+                        dims.append(next(it))
+                feed[s.name] = _sds(tuple(dims), s.scalar_type.jax_dtype)
+            return jax.eval_shape(self.fn, feed)
+
+        out_a = probe([1013, 1019, 1021, 1031, 1033, 1039, 1049, 1051])
+        out_b = probe([2003, 2011, 2017, 2027, 2029, 2039, 2053, 2063])
 
         class _O:
             def __init__(self, shape, dtype):
                 self.shape = shape
                 self.dtype = dtype
 
-        # dims equal to a fill size inherited an Unknown input dim; None is
-        # the non-int marker _shape_from_abstract maps back to Unknown.
+        # None is the non-int marker _shape_from_abstract maps to Unknown.
         return {
-            k: _O(tuple(None if d in fill_values else d for d in v.shape), v.dtype)
-            for k, v in out.items()
+            k: _O(
+                tuple(
+                    da if da == db else None
+                    for da, db in zip(va.shape, out_b[k].shape)
+                ),
+                va.dtype,
+            )
+            for k, va in out_a.items()
         }
 
     def node_summaries(

@@ -110,6 +110,19 @@ def _check_flat(flat: np.ndarray, offsets: np.ndarray):
         raise ValueError("flat must be a contiguous 1-D array")
     if offsets.dtype != np.int64 or offsets.ndim != 1:
         raise ValueError("offsets must be a 1-D int64 array")
+    # the native path drives memcpy straight off this pointer: a
+    # non-contiguous, decreasing, or out-of-range offsets array would turn
+    # into negative lengths / out-of-bounds reads, so validate up front
+    if not offsets.flags.c_contiguous:
+        raise ValueError("offsets must be contiguous")
+    if len(offsets) == 0 or offsets[0] != 0:
+        raise ValueError("offsets must start at 0")
+    if len(offsets) > 1 and bool(np.any(np.diff(offsets) < 0)):
+        raise ValueError("offsets must be non-decreasing")
+    if int(offsets[-1]) > len(flat):
+        raise ValueError(
+            f"offsets end at {int(offsets[-1])} beyond flat length {len(flat)}"
+        )
 
 
 def pad_ragged(

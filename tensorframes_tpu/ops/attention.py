@@ -87,8 +87,14 @@ def attention_reference(
         lq, lk = q.shape[2], k.shape[2]
         qi = jnp.arange(lq)[:, None] + (lk - lq)
         ki = jnp.arange(lk)[None, :]
-        s = jnp.where(qi >= ki, s, _NEG_BIG)
+        valid = qi >= ki
+        s = jnp.where(valid, s, _NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
+    if causal:
+        # lq > lk leaves early rows with no visible key at all; the kernels
+        # return zeros for such rows (l == 0 finalize), so the oracle must
+        # too rather than softmax-averaging over the mask fill
+        p = jnp.where(valid.any(axis=-1, keepdims=True), p, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
         q.dtype
     )

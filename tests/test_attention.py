@@ -103,3 +103,28 @@ class TestRingAttention:
         q, k, v = qkv(nprng, l=30)
         with pytest.raises(ValueError, match="divide"):
             ring_attention(q, k, v, mesh=mesh)
+
+
+class TestFullyMaskedRows:
+    """Causal attention with lq > lk leaves early query rows with no visible
+    key; the convention (everywhere) is zeros for such rows, not a uniform
+    average of V."""
+
+    def test_reference_zeros_fully_masked(self, nprng):
+        rng = nprng
+        q = jnp.asarray(rng.normal(size=(1, 2, 16, 8)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 2, 8, 8)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 2, 8, 8)).astype(np.float32))
+        ref = np.asarray(attention_reference(q, k, v, causal=True))
+        # offset = lk - lq = -8: rows 0..7 see no key at all
+        np.testing.assert_array_equal(ref[:, :, :8], 0.0)
+        assert np.abs(ref[:, :, 8:]).min() > 0
+
+    def test_flash_matches_reference_lq_gt_lk(self, nprng):
+        rng = nprng
+        q = jnp.asarray(rng.normal(size=(1, 2, 16, 8)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 2, 8, 8)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 2, 8, 8)).astype(np.float32))
+        out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)

@@ -264,3 +264,29 @@ def test_analyze_cache_keys_on_x64_state():
     )
     assert res.returncode == 0, res.stderr[-2000:]
     assert "x64-keyed OK" in res.stdout
+
+
+class TestConcreteProbe:
+    """When symbolic tracing fails, analyze falls back to a double concrete
+    probe; output dims that track the fill size are Unknown, genuinely fixed
+    dims are kept — even when they collide with a plausible fill value."""
+
+    def _graph(self, fixed):
+        import jax.numpy as jnp
+
+        # int() on a symbolic dim raises, forcing the concrete-probe path
+        def fn(x):
+            return {"z": jnp.zeros((int(x.shape[0]), fixed), x.dtype)}
+
+        return cap.CapturedGraph.from_callable(
+            fn, {"x": (FLOAT64, Shape(Unknown))}, fetch_names=["z"]
+        )
+
+    def test_inherited_dim_marked_unknown(self):
+        out = self._graph(13).analyze()
+        assert out["z"].shape == Shape(Unknown, 13)
+
+    def test_fixed_dim_equal_to_fill_value_kept(self):
+        # 1013 is one of the probe fills; a constant 1013 must survive
+        out = self._graph(1013).analyze()
+        assert out["z"].shape == Shape(Unknown, 1013)

@@ -182,3 +182,34 @@ def test_fallback_matches_native(rng, monkeypatch):
     monkeypatch.setattr(packer_mod, "_load", lambda: None)
     fallback = pad_ragged(flat, offsets, pad_value=9)
     np.testing.assert_array_equal(native, fallback)
+
+
+class TestOffsetsValidation:
+    """Offsets feed memcpy lengths in the native path (`native/packer.cpp`);
+    malformed arrays must be rejected before the pointer crosses the ABI."""
+
+    def test_offsets_must_start_at_zero(self):
+        with pytest.raises(ValueError, match="start at 0"):
+            pad_ragged(np.arange(4.0), np.array([1, 2, 4], dtype=np.int64))
+
+    def test_offsets_must_be_non_decreasing(self):
+        with pytest.raises(ValueError, match="non-decreasing"):
+            pad_ragged(np.arange(4.0), np.array([0, 3, 1], dtype=np.int64))
+
+    def test_offsets_must_stay_in_bounds(self):
+        with pytest.raises(ValueError, match="beyond flat length"):
+            pad_ragged(np.arange(4.0), np.array([0, 2, 9], dtype=np.int64))
+
+    def test_offsets_must_be_contiguous(self):
+        off = np.array([0, 7, 1, 9, 2, 11], dtype=np.int64)[::2]
+        with pytest.raises(ValueError, match="contiguous"):
+            pad_ragged(np.arange(4.0), off)
+
+    def test_gather_checks_too(self):
+        with pytest.raises(ValueError, match="non-decreasing"):
+            gather_ragged_pad(
+                np.arange(4.0),
+                np.array([0, 3, 2], dtype=np.int64),
+                np.array([0]),
+                4,
+            )
