@@ -456,22 +456,30 @@ def _map_rows_thunk(
             if cd.dense is None and cd.cells[0].ndim == 1:
                 ragged_bufs[ph] = RaggedBuffer.from_cells(cd.cells)
         out_cells: Dict[str, List] = {name: [None] * n for name in fetch_names}
+        from ..utils import get_config
+
+        # buckets larger than the per-call row cap run in chunks: the input
+        # bytes may be modest but the program's activations (convs,
+        # attention) scale with the batch, so the cap bounds peak HBM
+        chunk = max(1, get_config().max_rows_per_device_call)
         for _, idxs in buckets.items():
-            idx_arr = np.asarray(idxs, dtype=np.int64)
-            feed = {}
-            for ph in binding:
-                cd = col_data[ph]
-                if cd.dense is not None:
-                    feed[ph] = gather_rows(cd.host(), idx_arr)
-                elif ph in ragged_bufs:
-                    feed[ph] = ragged_bufs[ph].gather_pad(idx_arr)
-                else:
-                    feed[ph] = np.stack([cd.cell(i) for i in idxs])
-            res = run_bucket(feed, len(idxs))
-            for name in fetch_names:
-                arr = np.asarray(res[name])
-                for j, i in enumerate(idxs):
-                    out_cells[name][i] = arr[j]
+            for lo in range(0, len(idxs), chunk):
+                sub = idxs[lo : lo + chunk]
+                idx_arr = np.asarray(sub, dtype=np.int64)
+                feed = {}
+                for ph in binding:
+                    cd = col_data[ph]
+                    if cd.dense is not None:
+                        feed[ph] = gather_rows(cd.host(), idx_arr)
+                    elif ph in ragged_bufs:
+                        feed[ph] = ragged_bufs[ph].gather_pad(idx_arr)
+                    else:
+                        feed[ph] = np.stack([cd.cell(i) for i in sub])
+                res = run_bucket(feed, len(sub))
+                for name in fetch_names:
+                    arr = np.asarray(res[name])
+                    for j, i in enumerate(sub):
+                        out_cells[name][i] = arr[j]
         cols: Dict[str, _ColumnData] = {}
         for name in fetch_names:
             cd, _ = _build_column(name, out_cells[name])
@@ -490,16 +498,48 @@ def _map_rows_thunk(
     return thunk
 
 
+def apply_decoders(
+    dframe: TensorFrame,
+    decoders: Dict[str, Callable],
+    feed_dict: Optional[Dict[str, str]] = None,
+) -> TensorFrame:
+    """Stack host decode stages onto a frame (see
+    :meth:`TensorFrame.decode_column`). Keys are column names, or
+    placeholder names routed through ``feed_dict`` — matching how the
+    reference binds its string tensor to the bytes column
+    (``read_image.py:158-160``). Decoding is forced here and the result
+    ``analyze``d so downstream capture sees concrete cell shapes (the
+    reference likewise requires ``tfs.analyze`` before non-scalar ops)."""
+    for key, fn in decoders.items():
+        # explicit feed_dict routing wins: a placeholder may collide with an
+        # unrelated column name
+        if feed_dict and key in feed_dict:
+            col = feed_dict[key]
+        elif key in dframe.schema.names:
+            col = key
+        else:
+            raise InputNotFoundError([key], dframe.schema.names)
+        dframe = dframe.decode_column(col, fn)
+    return dframe.analyze()
+
+
 def map_rows(
     fetches,
     dframe: TensorFrame,
     feed_dict: Optional[Dict[str, str]] = None,
+    decoders: Optional[Dict[str, Callable]] = None,
 ) -> TensorFrame:
     """Transform row by row (``core.py:223-264``). Rows with equal cell
     shapes are batched and executed with ``vmap`` in one XLA program per
     shape bucket — the TPU replacement for the reference's one-Session.run-
     per-row loop (``performMapRows``, ``DebugRowOps.scala:819-857``). Ragged
-    columns are supported; binary columns run on the host path."""
+    columns are supported; binary columns run on the host path — or, with
+    ``decoders={placeholder_or_column: bytes -> array}``, decode on the
+    host and batch the numeric program on device (the reference's
+    decode-in-graph image scoring, ``read_image.py:147-167``, done the
+    TPU way)."""
+    if decoders:
+        dframe = apply_decoders(dframe, decoders, feed_dict)
     g = _as_graph(fetches, dframe, cell_inputs=True, feed_dict=feed_dict)
     binding = validate_map_inputs(g, dframe.schema, block=False)
     _ensure_precision(g, dframe.schema)

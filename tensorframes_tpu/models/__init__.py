@@ -8,6 +8,7 @@ from .mlp import (
     mlp_loss,
     softmax_cross_entropy,
 )
+from .cnn import CNNScorer, cnn_embed, cnn_logits, init_cnn
 from .kmeans import kmeans, assign_clusters
 from .transformer import (
     TransformerLM,
@@ -17,6 +18,10 @@ from .transformer import (
 )
 
 __all__ = [
+    "CNNScorer",
+    "cnn_embed",
+    "cnn_logits",
+    "init_cnn",
     "TransformerLM",
     "init_transformer",
     "transformer_logits",

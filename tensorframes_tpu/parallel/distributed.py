@@ -288,6 +288,7 @@ def map_rows(
     dframe: TensorFrame,
     mesh=None,
     feed_dict: Optional[Dict[str, str]] = None,
+    decoders: Optional[Dict[str, Callable]] = None,
 ) -> TensorFrame:
     """Distributed row-wise map: rows are bucketed by input cell shape (as in
     the local engine), and each bucket runs as one ``shard_map``-of-``vmap``
@@ -303,6 +304,10 @@ def map_rows(
     import jax
 
     mesh = _mesh_or_default(mesh)
+    if decoders:
+        from ..engine.ops import apply_decoders
+
+        dframe = apply_decoders(dframe, decoders, feed_dict)
     g = _as_graph(fetches, dframe, cell_inputs=True, feed_dict=feed_dict)
     binding = validate_map_inputs(g, dframe.schema, block=False)
     host_mode = any(

@@ -21,6 +21,11 @@ class Config:
     #: bounds HBM use for frames larger than device memory
     #: (consumed by engine/ops.py and parallel/distributed.py).
     device_cache_bytes: int = 4 << 30
+    #: upper bound on rows per vmapped device call in ``map_rows`` shape
+    #: buckets; a bucket larger than this executes in chunks so activation
+    #: memory stays bounded (conv/attention programs can blow up HBM far
+    #: beyond the input bytes). Consumed by engine/ops.py.
+    max_rows_per_device_call: int = 8192
 
 
 _lock = threading.Lock()
