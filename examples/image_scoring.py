@@ -43,7 +43,8 @@ def main():
     assert emb.shape == (n, 256)
 
     # the same program scales over a device mesh unchanged:
-    #   scorer.score_frame(df, "image_data", engine=tft.parallel)
+    #   from tensorframes_tpu import parallel
+    #   scorer.score_frame(df, "image_data", engine=parallel)
 
 
 if __name__ == "__main__":

@@ -508,7 +508,7 @@ class TensorFrame:
         def thunk() -> "TensorFrame":
             cells = list(src.iter_cells())
             n = len(cells)
-            if num_threads == 0 or n < 64:
+            if num_threads == 0 or (num_threads is None and n < 64):
                 decoded = [_as_cell(fn(c)) for c in cells]
             else:
                 import os

@@ -156,7 +156,16 @@ class CNNScorer:
     ):
         """Decode ``col`` (binary) and append ``embedding_col``. ``engine``
         defaults to the local engine; pass ``tensorframes_tpu.parallel`` to
-        shard the scoring over the mesh."""
+        shard the scoring over the mesh.
+
+        ``map_blocks`` programs see a whole partition block, so the block
+        size is the activation-memory knob; the result is repartitioned
+        upward when needed so no block exceeds
+        ``config.max_rows_per_device_call`` rows (block *count* may
+        therefore differ from the input frame's). Chunking inside a block
+        is not an option in general — block programs may compute
+        cross-row statistics — so the split happens at the partition
+        level, which is semantically free."""
         from .. import engine as local_engine
 
         eng = engine or local_engine

@@ -7,8 +7,10 @@ funnel, SURVEY §2.5) with ``shard_map`` programs and XLA collectives.
 from .mesh import make_mesh, default_mesh, data_axis
 from .distributed import map_blocks, map_rows, reduce_blocks, reduce_rows, aggregate
 from .training import ShardedSGDTrainer
+from . import multihost
 
 __all__ = [
+    "multihost",
     "make_mesh",
     "default_mesh",
     "data_axis",

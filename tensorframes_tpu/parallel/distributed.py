@@ -31,8 +31,11 @@ device-sharded copies of immutable columns are memoized per (mesh, split) —
 iterative algorithms pay tracing and host->device movement once.
 
 Multi-host: this module only speaks ``jax.devices()`` — under
-``jax.distributed.initialize`` the same code sees all hosts' addressable
-devices and the collectives ride DCN across hosts; no code change needed.
+``jax.distributed.initialize`` the same compiled programs span all hosts'
+devices with collectives over DCN. Host-side feeds, however, must come from
+each process's addressable rows: :mod:`tensorframes_tpu.parallel.multihost`
+provides the per-host input pipeline (``global_batch``/``local_rows``),
+exercised for real by the two-process suite in ``tests/test_multihost.py``.
 """
 
 from __future__ import annotations

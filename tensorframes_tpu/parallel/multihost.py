@@ -1,0 +1,134 @@
+"""Multi-host (multi-process) execution: the DCN story.
+
+The reference's cross-host communication *is* Spark: Py4J control plane,
+torrent broadcast of the graph, shuffle for groupBy, and an
+executors-to-driver funnel for reduces
+(``/root/reference/src/main/scala/org/tensorframes/impl/DebugRowOps.scala:376,524,576``).
+The TPU-native replacement has no driver funnel: every host runs the SAME
+program, ``jax.distributed.initialize`` wires the processes into one
+runtime, meshes span every host's devices, and XLA routes collectives over
+ICI within a pod and DCN across pods/hosts (SURVEY §2.5). Each host feeds
+only its addressable shard (per-host input pipelines — the part the
+reference never solved, SURVEY §7 hard-part 6).
+
+On CPU this is exercised for real: multiple processes with virtual
+devices, cross-process collectives over Gloo — the same code path
+``jax.distributed`` uses across TPU hosts over DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "initialize",
+    "is_multihost",
+    "process_count",
+    "process_index",
+    "global_batch",
+    "local_rows",
+    "sync_global",
+]
+
+
+def initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    local_device_count: Optional[int] = None,
+) -> None:
+    """Join this process into a multi-host runtime.
+
+    Thin wrapper over ``jax.distributed.initialize`` that can also size the
+    CPU backend at ``local_device_count`` virtual devices per process —
+    the testing topology (N processes x M virtual devices) that stands in
+    for N hosts x M chips. Must run before any jax computation initializes
+    the backends."""
+    import jax
+
+    if local_device_count is not None:
+        try:
+            jax.config.update("jax_num_cpu_devices", local_device_count)
+        except Exception as e:  # backends already initialized, or old jax
+            from ..utils import get_logger
+
+            get_logger("multihost").warning(
+                "could not size the CPU backend at %d devices (%s); "
+                "device count will be whatever the backend reports",
+                local_device_count,
+                e,
+            )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def global_batch(local: np.ndarray, mesh, spec=None):
+    """Assemble a globally-sharded array from each process's local rows.
+
+    ``local`` is THIS process's slice along the leading (row) axis; every
+    process contributes its own. ``spec`` defaults to rows-over-``dp``,
+    trailing dims replicated. The result is addressable-shard-backed: no
+    host ever materializes the global array (the reference, by contrast,
+    funnels global state through the driver)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import DATA_AXIS
+
+    if spec is None:
+        spec = P(DATA_AXIS, *([None] * (np.ndim(local) - 1)))
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(local))
+
+
+def local_rows(n_rows: int) -> slice:
+    """The contiguous row range this process should load, under the even
+    row split ``global_batch`` expects: process i of p takes rows
+    ``[i*n/p, (i+1)*n/p)``."""
+    import jax
+
+    p, i = jax.process_count(), jax.process_index()
+    if n_rows % p != 0:
+        raise ValueError(
+            f"{n_rows} rows do not split evenly over {p} processes; pad or "
+            f"trim the dataset so every host feeds the same shard size"
+        )
+    per = n_rows // p
+    return slice(i * per, (i + 1) * per)
+
+
+def sync_global(x):
+    """Fetch a (replicated or sharded) global array to every host, via an
+    all-gather across processes when needed. For small results only —
+    this is the one deliberate host materialization point."""
+    import jax
+
+    arr = x
+    if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return np.asarray(arr)

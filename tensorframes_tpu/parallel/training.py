@@ -106,9 +106,18 @@ class ShardedSGDTrainer:
         return jax.device_put(host, self.param_shardings())
 
     def place_batch(self, x: np.ndarray, y: np.ndarray):
+        """Place a batch with the dp/tp shardings. Single-process: a plain
+        sharded transfer. Multi-host: ``x``/``y`` are this process's local
+        rows and each host contributes its addressable shard — no host
+        holds the global batch (see :mod:`.multihost`)."""
         import jax
 
         xs, ys = self.batch_shardings()
+        if jax.process_count() > 1:
+            return (
+                jax.make_array_from_process_local_data(xs, np.asarray(x)),
+                jax.make_array_from_process_local_data(ys, np.asarray(y)),
+            )
         return jax.device_put(x, xs), jax.device_put(y, ys)
 
     # -- the step ----------------------------------------------------------

@@ -1,0 +1,129 @@
+"""Real multi-process execution: 2 processes x 4 virtual CPU devices.
+
+Everything else in the suite runs distribution semantics inside ONE
+process over 8 virtual devices. These tests spawn two actual processes
+joined by ``jax.distributed.initialize`` (cross-process collectives over
+Gloo — the code path that rides DCN between TPU hosts), each feeding only
+its local rows, and check the result against a single-process oracle. The
+reference never tests across real executors (SURVEY §4: `local[1]`
+masters only); this goes one step further than it did.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import json, sys
+import numpy as np
+from tensorframes_tpu.parallel import multihost
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+multihost.initialize(
+    f"localhost:{port}", num_processes=2, process_id=pid, local_device_count=4
+)
+import jax
+assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+from tensorframes_tpu.parallel import ShardedSGDTrainer, make_mesh
+
+mesh = make_mesh({"dp": 4, "tp": 2})
+trainer = ShardedSGDTrainer([8, 16, 4], mesh=mesh, lr=0.1)
+
+rng = np.random.default_rng(7)
+x = rng.normal(size=(32, 8)).astype(np.float32)
+y = rng.integers(0, 4, size=(32,)).astype(np.int32)
+rows = multihost.local_rows(32)
+
+params, losses = trainer.fit(x[rows], y[rows], steps=5, seed=3)
+
+# cross-process psum sanity: global sum assembled from local halves
+local = np.arange(4.0) + 4 * pid
+total = multihost.sync_global(
+    jax.jit(lambda a: a.sum())(multihost.global_batch(local, mesh))
+)
+
+# uneven row split must be rejected under 2 processes
+try:
+    multihost.local_rows(33)
+    uneven_rejected = False
+except ValueError:
+    uneven_rejected = True
+
+if pid == 0:
+    print("RESULT " + json.dumps(
+        {"losses": losses, "psum": float(total),
+         "uneven_rejected": uneven_rejected}
+    ), flush=True)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def two_process_result(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mh")
+    worker = d / "worker.py"
+    worker.write_text(_WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+    line = next(
+        l for l in outs[0][0].splitlines() if l.startswith("RESULT ")
+    )
+    return json.loads(line[len("RESULT "):])
+
+
+class TestTwoProcess:
+    def test_cross_process_collective(self, two_process_result):
+        # sum over a dp-sharded array whose halves live in different
+        # processes: 0+1+...+7
+        assert two_process_result["psum"] == 28.0
+
+    def test_sgd_matches_single_process_oracle(self, two_process_result):
+        from tensorframes_tpu.parallel import ShardedSGDTrainer, make_mesh
+
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        trainer = ShardedSGDTrainer([8, 16, 4], mesh=mesh, lr=0.1)
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=(32,)).astype(np.int32)
+        _, oracle = trainer.fit(x, y, steps=5, seed=3)
+        np.testing.assert_allclose(
+            two_process_result["losses"], oracle, rtol=1e-5, atol=1e-6
+        )
+
+
+class TestLocalRowsHelper:
+    def test_single_process_full_range(self):
+        from tensorframes_tpu.parallel import multihost
+
+        assert multihost.local_rows(10) == slice(0, 10)
+
+    def test_uneven_split_rejected_two_process(self, two_process_result):
+        # exercised inside the 2-process worker, where 33 % 2 != 0
+        assert two_process_result["uneven_rejected"] is True
