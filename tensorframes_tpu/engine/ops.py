@@ -71,6 +71,9 @@ row = _dsl.row
 
 #: per-callable CapturedGraph memo (see _graph_from_callable)
 _callable_graphs: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+#: (code, spec) signatures already captured once — used to warn on
+#: recompile churn from lambdas recreated per call
+_seen_callable_codes: set = set()
 
 
 # ---------------------------------------------------------------------------
@@ -171,6 +174,29 @@ def _graph_from_callable(
         per_fn = {}
     if cache_key in per_fn:
         return per_fn[cache_key]
+    # capture is memoized by FUNCTION IDENTITY; a lambda recreated inside a
+    # loop has the same code but a new identity every pass, silently
+    # recompiling its programs. Detect the churn and tell the user once.
+    # Bound methods, closures, and default-args carriers legitimately share
+    # code across distinct functions, so only bare code-only functions warn.
+    code = getattr(fn, "__code__", None)
+    if (
+        code is not None
+        and getattr(fn, "__closure__", True) is None
+        and getattr(fn, "__defaults__", True) is None
+        and not hasattr(fn, "__self__")
+    ):
+        code_key = (code, cache_key)
+        if code_key in _seen_callable_codes:
+            logger.warning(
+                "capturing %s again for identical code — it is a new "
+                "function object each call, so compiled programs are not "
+                "reused; define the function once and pass the same object "
+                "to avoid recompilation",
+                getattr(fn, "__qualname__", fn),
+            )
+        elif len(_seen_callable_codes) < 4096:  # bounded diagnostic state
+            _seen_callable_codes.add(code_key)
     probe_feed = None
     if any(st.name == "binary" for st, _ in specs.values()):
         # binary programs cannot be abstract-traced; discover outputs by
@@ -214,12 +240,18 @@ def _block_feeder(cd):
     from ..frame.table import _is_device_array
     from ..utils import get_config
 
+    def _slicer(arr):
+        # a [0:n] slice of a device array is an eager on-device copy — for
+        # a single-partition frame that would double the pass's HBM
+        # traffic, so the full range returns the array itself
+        n = arr.shape[0]
+        return lambda lo, hi: arr if lo == 0 and hi == n else arr[lo:hi]
+
     dense = cd.dense
     if _is_device_array(dense):
-        return (lambda lo, hi: dense[lo:hi]), False
+        return _slicer(dense), False
     if dense.nbytes <= get_config().device_cache_bytes:
-        dev = cd.device()
-        return (lambda lo, hi: dev[lo:hi]), False
+        return _slicer(cd.device()), False
     return (lambda lo, hi: dense[lo:hi]), True
 
 
@@ -352,7 +384,30 @@ def map_blocks(
                 continue
             feed = {ph: feeders[ph](lo, hi) for ph in binding}
             feed.update(const_feed)
-            res = jit_fn(feed)
+            from ..utils import is_oom, run_with_retries
+
+            # NOTE: map_blocks keeps results device-resident so chained
+            # passes pipeline without host syncs (the 20x headline win in
+            # bench.py). The deliberate cost: only errors raised at
+            # DISPATCH are retried/classified here — a failure during
+            # async execution surfaces later, at materialization. map_rows
+            # and the reduces, which materialize promptly, sync inside
+            # their retry windows and get full coverage.
+            try:
+                res = run_with_retries(
+                    lambda: jit_fn(feed), what=f"map_blocks partition {p}"
+                )
+            except Exception as e:
+                if is_oom(e):
+                    from ..utils.failures import DeviceOOMError
+
+                    raise DeviceOOMError(
+                        f"map_blocks partition {p} ({n} rows) exhausted "
+                        f"device memory; repartition the frame into smaller "
+                        f"blocks (block programs see a whole partition, so "
+                        f"the engine cannot split one for you)"
+                    ) from e
+                raise
             # results stay device-resident: shape checks need no host sync,
             # and the host transfer happens only on host access (collect /
             # column host materialization) — chained ops feed from HBM
@@ -462,24 +517,52 @@ def _map_rows_thunk(
         # bytes may be modest but the program's activations (convs,
         # attention) scale with the batch, so the cap bounds peak HBM
         chunk = max(1, get_config().max_rows_per_device_call)
+        from ..utils import is_oom, run_with_retries
+
+        def run_chunk(sub):
+            idx_arr = np.asarray(sub, dtype=np.int64)
+            feed = {}
+            for ph in binding:
+                cd = col_data[ph]
+                if cd.dense is not None:
+                    feed[ph] = gather_rows(cd.host(), idx_arr)
+                elif ph in ragged_bufs:
+                    feed[ph] = ragged_bufs[ph].gather_pad(idx_arr)
+                else:
+                    feed[ph] = np.stack([cd.cell(i) for i in sub])
+            def dispatch():
+                import jax
+
+                # sync INSIDE the retry window: jax dispatch is async, so
+                # without this the failure would surface at np.asarray
+                # below, past the handlers. The chunk is materialized to
+                # host right after anyway, so the sync costs nothing.
+                return jax.block_until_ready(run_bucket(feed, len(sub)))
+
+            try:
+                res = run_with_retries(dispatch, what="map_rows chunk")
+            except Exception as e:
+                # rows are independent, so an OOM chunk is safe to halve
+                # (unlike a map_blocks partition); recurse down to 1 row
+                if is_oom(e) and len(sub) > 1:
+                    logger.warning(
+                        "map_rows chunk of %d rows exhausted device memory; "
+                        "halving", len(sub),
+                    )
+                    del feed
+                    mid = len(sub) // 2
+                    run_chunk(sub[:mid])
+                    run_chunk(sub[mid:])
+                    return
+                raise
+            for name in fetch_names:
+                arr = np.asarray(res[name])
+                for j, i in enumerate(sub):
+                    out_cells[name][i] = arr[j]
+
         for _, idxs in buckets.items():
             for lo in range(0, len(idxs), chunk):
-                sub = idxs[lo : lo + chunk]
-                idx_arr = np.asarray(sub, dtype=np.int64)
-                feed = {}
-                for ph in binding:
-                    cd = col_data[ph]
-                    if cd.dense is not None:
-                        feed[ph] = gather_rows(cd.host(), idx_arr)
-                    elif ph in ragged_bufs:
-                        feed[ph] = ragged_bufs[ph].gather_pad(idx_arr)
-                    else:
-                        feed[ph] = np.stack([cd.cell(i) for i in sub])
-                res = run_bucket(feed, len(sub))
-                for name in fetch_names:
-                    arr = np.asarray(res[name])
-                    for j, i in enumerate(sub):
-                        out_cells[name][i] = arr[j]
+                run_chunk(idxs[lo : lo + chunk])
         cols: Dict[str, _ColumnData] = {}
         for name in fetch_names:
             cd, _ = _build_column(name, out_cells[name])
@@ -678,7 +761,19 @@ def reduce_blocks(fetches, dframe: TensorFrame):
         if hi - lo == 0:
             continue
         feed = {f"{f}_input": feeders[f](lo, hi) for f in binding}
-        partials.append(jit_fn(feed))
+        from ..utils import run_with_retries
+
+        def dispatch(_feed=feed):
+            import jax
+
+            # sync inside the retry window (async failures would otherwise
+            # surface in the fold below); reduce is eager, partials are
+            # consumed immediately, so the sync is effectively free
+            return jax.block_until_ready(jit_fn(_feed))
+
+        partials.append(
+            run_with_retries(dispatch, what=f"reduce_blocks partition {p}")
+        )
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
     import jax.numpy as jnp
@@ -764,10 +859,32 @@ def reduce_rows(fetches, dframe: TensorFrame):
 #: compile time grows with log2(rows scanned), so large frames are scanned
 #: as [m, _AGG_CHUNK] with vmap (fixed depth, one compile per cell shape)
 #: and per-chunk boundary partials merged by a recursive final pass
-_AGG_CHUNK = 8192
+_AGG_CHUNK = 32768
 
 
 def _group_sort(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple:
+    """Memoizing wrapper around :func:`_group_sort_impl`: frames are
+    immutable, so the sort permutation for a given key tuple is computed
+    once per frame — repeated aggregates over the same grouping (different
+    fetches, iterative passes) skip the sort and its host sync entirely."""
+    cache = getattr(dframe, "_group_sort_cache", None)
+    if cache is None:
+        cache = dframe._group_sort_cache = {}
+    ck = tuple(keys)
+    hit = cache.get(ck)
+    if hit is None:
+        hit = cache[ck] = _group_sort_impl(dframe, keys, binding)
+    else:
+        # the binding checks in the impl are per-call (key/input overlap)
+        for k in keys:
+            if k in binding.values():
+                raise ValueError(
+                    f"column {k!r} cannot be both key and input"
+                )
+    return hit
+
+
+def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple:
     """Group-key machinery shared by the local and distributed aggregates.
 
     Supports numeric scalar keys, binary (bytes/string) keys, and
@@ -1009,8 +1126,29 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
         for f in fetch_names:
             partial_cols[f] = scanned[f][ci, co]  # device gather, #partials rows
         partials = TensorFrame.from_columns(partial_cols).analyze()
-        g2 = g.with_inputs({f"{f}_input": f for f in fetch_names})
-        return aggregate(g2, GroupedFrame(partials, keys))
+        # cache the renamed final-merge graph ON g: a fresh CapturedGraph
+        # per pass would drop its jitted scan programs and recompile the
+        # final scan on every aggregate call
+        g2 = getattr(g, "_agg_final_graph", None)
+        if g2 is None:
+            g2 = g._agg_final_graph = g.with_inputs(
+                {f"{f}_input": f for f in fetch_names}
+            )
+        # the partial table's KEY STRUCTURE (sort order, segment flags) is
+        # deterministic for a given parent frame + keys + chunking, even
+        # though its values change per pass — seed the fresh frame's sort
+        # cache with the previous pass's WHOLE cache dict (it also carries
+        # the deeper recursion levels' seeds), so repeated aggregates skip
+        # every per-level device sync after the first pass
+        seed_key = (tuple(keys), "__partials__", len(ends))
+        seed = dframe._group_sort_cache.get(seed_key)
+        if seed is not None:
+            partials._group_sort_cache = seed
+        result = aggregate(g2, GroupedFrame(partials, keys))
+        dframe._group_sort_cache[seed_key] = getattr(
+            partials, "_group_sort_cache", {}
+        )
+        return result
 
     out_specs = g.analyze(
         {

@@ -177,7 +177,10 @@ class CNNScorer:
             dt = jnp.bfloat16 if compute_dtype == "bfloat16" else None
             return {embedding_col: cnn_embed(params, images, compute_dtype=dt)}
 
-        decoded = df.decode_column(col, self.decode).analyze()
+        if df.schema[col].scalar_type.name == "binary":
+            decoded = df.decode_column(col, self.decode).analyze()
+        else:
+            decoded = df.analyze()  # already decoded (e.g. cached upstream)
         # map_blocks runs one XLA program per partition block, so conv
         # activation memory scales with the block; split so no block
         # exceeds the map_rows per-call row cap

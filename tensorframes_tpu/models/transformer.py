@@ -199,6 +199,9 @@ class TransformerLM:
 
         from ..engine import map_blocks
 
+        # capture needs the concrete [L] cell shape (positional embeddings
+        # are length-dependent); analyze is O(1) for dense columns
+        df = df.analyze()
         params = self.params
 
         def fn(**cols):

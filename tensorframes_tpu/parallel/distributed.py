@@ -98,13 +98,30 @@ def _split(n: int, ndev: int):
 
 def _cached_program(g, key, build: Callable[[], Any]):
     """Memoize a compiled program on the CapturedGraph (the distributed
-    analog of the local engine's ``g._jit_cache``)."""
+    analog of the local engine's ``g._jit_cache``). Dispatches retry on
+    transient runtime failures, same policy as the local engine
+    (``utils.failures``; the reference leans on Spark task retry here)."""
+    from ..utils import run_with_retries
+
     cache = getattr(g, "_shard_cache", None)
     if cache is None:
         cache = {}
         g._shard_cache = cache
     if key not in cache:
-        cache[key] = build()
+        prog = build()
+
+        def dispatch(*a, _prog=prog, _key=key, **k):
+            def _run():
+                import jax
+
+                # sync inside the retry window — async failures would
+                # otherwise surface later, past the handler; distributed
+                # results are materialized promptly by their callers
+                return jax.block_until_ready(_prog(*a, **k))
+
+            return run_with_retries(_run, what=f"distributed program {_key}")
+
+        cache[key] = dispatch
     return cache[key]
 
 
@@ -574,7 +591,9 @@ def aggregate(
 
     order, flags, emit_keys = _group_sort(df, keys, binding)
     main, tail = _split(n, ndev)
-    # each shard's scan restarts: force a segment start at shard boundaries
+    # each shard's scan restarts: force a segment start at shard boundaries.
+    # _group_sort memoizes its result on the frame, so mutate a copy
+    flags = flags.copy()
     shard_rows = main // ndev
     flags[np.arange(1, ndev) * shard_rows] = True
     if tail:

@@ -2,6 +2,7 @@
 
 from .config import Config, get_config, set_config, ensure_x64
 from .logging import get_logger
+from .failures import DeviceOOMError, is_oom, is_transient, run_with_retries
 from . import profiling
 
 __all__ = [
@@ -10,5 +11,9 @@ __all__ = [
     "set_config",
     "ensure_x64",
     "get_logger",
+    "DeviceOOMError",
+    "is_oom",
+    "is_transient",
+    "run_with_retries",
     "profiling",
 ]

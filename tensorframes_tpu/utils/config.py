@@ -26,6 +26,12 @@ class Config:
     #: memory stays bounded (conv/attention programs can blow up HBM far
     #: beyond the input bytes). Consumed by engine/ops.py.
     max_rows_per_device_call: int = 8192
+    #: retries for transient device-runtime failures (UNAVAILABLE /
+    #: DEADLINE_EXCEEDED / dropped tunnel); see utils/failures.py. The
+    #: reference rode Spark's task retry instead (SURVEY §5).
+    max_retries: int = 2
+    #: base of the exponential retry backoff, seconds.
+    retry_backoff_s: float = 0.5
 
 
 _lock = threading.Lock()

@@ -1,0 +1,105 @@
+"""Failure handling: retry transient device errors, degrade on OOM.
+
+The reference has no failure machinery of its own — it rides Spark's task
+retry and lineage (SURVEY §5: "fully delegated to Spark"). There is no
+Spark here, so the engine carries its own, sized to how a PJRT/TPU runtime
+actually fails:
+
+- **transient runtime errors** (preempted tunnel, UNAVAILABLE /
+  DEADLINE_EXCEEDED from the PJRT client, dropped connection): the program
+  and its inputs are still on the host or reproducible from it, so the
+  dispatch is safe to retry with backoff — the same property Spark exploits
+  (pure per-task functions, ``DebugRowOps.scala:766-803``).
+- **RESOURCE_EXHAUSTED (HBM OOM)**: retrying identically cannot help; the
+  caller must shrink the work. ``map_rows`` halves its bucket chunks
+  (row programs are per-row independent, so splitting is semantics-free);
+  block ops surface the error with a hint, since a block program may
+  compute cross-row statistics and must see the whole partition.
+
+Coverage note — jax dispatch is asynchronous, so a retry window only sees
+errors raised before it returns. Ops that materialize results promptly
+(``map_rows`` chunks, the reduces, the distributed programs) synchronize
+*inside* their retry windows and get full coverage. ``map_blocks`` keeps
+results device-resident to pipeline chained passes; there, only
+dispatch-time failures are retried, and an error during async execution
+surfaces at the first materialization point instead.
+
+Everything here is policy-free mechanics; knobs live in
+:class:`tensorframes_tpu.utils.config.Config`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+from .logging import get_logger
+
+__all__ = ["is_oom", "is_transient", "run_with_retries", "DeviceOOMError"]
+
+logger = get_logger("failures")
+
+T = TypeVar("T")
+
+#: status substrings that mark a dispatch worth retrying (PJRT surfaces
+#: grpc-style statuses in the exception text)
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "connection reset",
+    "Connection reset",
+    "Socket closed",
+    "socket closed",
+)
+
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "OOM",
+)
+
+
+class DeviceOOMError(RuntimeError):
+    """Device memory exhausted and the op cannot shrink its work unit."""
+
+
+def is_oom(e: BaseException) -> bool:
+    s = str(e)
+    return any(m in s for m in _OOM_MARKERS)
+
+
+def is_transient(e: BaseException) -> bool:
+    if is_oom(e):
+        return False
+    s = str(e)
+    return any(m in s for m in _TRANSIENT_MARKERS)
+
+
+def run_with_retries(fn: Callable[[], T], what: str = "device dispatch") -> T:
+    """Run ``fn``, retrying transient runtime failures with exponential
+    backoff per the config (``max_retries`` / ``retry_backoff_s``). Raises
+    the last error when attempts run out; non-transient errors propagate
+    immediately."""
+    from .config import get_config
+
+    cfg = get_config()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not is_transient(e) or attempt >= cfg.max_retries:
+                raise
+            delay = cfg.retry_backoff_s * (2.0 ** attempt)
+            attempt += 1
+            logger.warning(
+                "%s failed with a transient error (%s); retry %d/%d in %.1fs",
+                what,
+                str(e).splitlines()[0][:200],
+                attempt,
+                cfg.max_retries,
+                delay,
+            )
+            time.sleep(delay)

@@ -32,7 +32,11 @@ def trace(log_dir: str, host_tracer_level: int = 2):
     """
     import jax
 
-    jax.profiler.start_trace(log_dir, host_tracer_level=host_tracer_level)
+    try:
+        jax.profiler.start_trace(log_dir, host_tracer_level=host_tracer_level)
+    except TypeError:
+        # newer jax moved tracer options off the start_trace signature
+        jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:

@@ -1,0 +1,154 @@
+"""Failure handling: transient retry + OOM degradation.
+
+The reference delegates all of this to Spark task retry (SURVEY §5); here
+the engine owns it. Device failures are injected by patching the jit
+wrappers — the classification layer only sees exception text, same as it
+would from a real PJRT client.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.engine import ops as engine_ops
+from tensorframes_tpu.frame import TensorFrame
+from tensorframes_tpu.utils import (
+    DeviceOOMError,
+    is_oom,
+    is_transient,
+    run_with_retries,
+    set_config,
+    get_config,
+)
+
+
+@pytest.fixture
+def fast_retries():
+    old = (get_config().max_retries, get_config().retry_backoff_s)
+    set_config(max_retries=2, retry_backoff_s=0.001)
+    yield
+    set_config(max_retries=old[0], retry_backoff_s=old[1])
+
+
+class TestClassification:
+    def test_oom(self):
+        assert is_oom(RuntimeError("RESOURCE_EXHAUSTED: while allocating"))
+        assert is_oom(RuntimeError("Out of memory allocating 16G"))
+        assert not is_oom(RuntimeError("UNAVAILABLE: socket closed"))
+
+    def test_transient(self):
+        assert is_transient(RuntimeError("UNAVAILABLE: connection reset"))
+        assert is_transient(RuntimeError("DEADLINE_EXCEEDED: 30s"))
+        assert not is_transient(ValueError("shapes do not match"))
+        # OOM is NOT transient: identical retry cannot help
+        assert not is_transient(RuntimeError("RESOURCE_EXHAUSTED"))
+
+
+class TestRunWithRetries:
+    def test_retries_then_succeeds(self, fast_retries):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("UNAVAILABLE: tunnel dropped")
+            return 42
+
+        assert run_with_retries(flaky) == 42
+        assert len(calls) == 3  # initial + 2 retries
+
+    def test_exhausts_and_raises(self, fast_retries):
+        def always():
+            raise RuntimeError("UNAVAILABLE: down")
+
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            run_with_retries(always)
+
+    def test_nontransient_raises_immediately(self, fast_retries):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("bug")
+
+        with pytest.raises(ValueError):
+            run_with_retries(bad)
+        assert len(calls) == 1
+
+
+class TestEngineIntegration:
+    def test_map_blocks_transient_retried(self, fast_retries, monkeypatch):
+        real = engine_ops._jitted
+        state = {"failed": False}
+
+        def flaky_jitted(g):
+            fn = real(g)
+
+            def wrapper(feed):
+                if not state["failed"]:
+                    state["failed"] = True
+                    raise RuntimeError("UNAVAILABLE: injected")
+                return fn(feed)
+
+            return wrapper
+
+        monkeypatch.setattr(engine_ops, "_jitted", flaky_jitted)
+        df = TensorFrame.from_columns({"x": np.arange(6.0)})
+        out = tft.map_blocks(lambda x: {"z": x + 1.0}, df).collect()
+        assert [r.z for r in out] == [float(i + 1) for i in range(6)]
+        assert state["failed"]
+
+    def test_map_blocks_oom_says_repartition(self, fast_retries, monkeypatch):
+        def oom_jitted(g):
+            def wrapper(feed):
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+            return wrapper
+
+        monkeypatch.setattr(engine_ops, "_jitted", oom_jitted)
+        df = TensorFrame.from_columns({"x": np.arange(6.0)})
+        with pytest.raises(DeviceOOMError, match="repartition"):
+            tft.map_blocks(lambda x: {"z": x + 1.0}, df).cache()
+
+    def test_distributed_program_dispatch_retries(self, fast_retries):
+        from tensorframes_tpu.parallel import distributed as D
+
+        class G:
+            pass
+
+        calls = []
+
+        def build():
+            def prog(x):
+                calls.append(1)
+                if len(calls) < 2:
+                    raise RuntimeError("UNAVAILABLE: injected")
+                return x + 1
+
+            return prog
+
+        p = D._cached_program(G(), "k", build)
+        assert p(1) == 2
+        assert len(calls) == 2
+
+    def test_map_rows_oom_halves_chunks(self, fast_retries, monkeypatch):
+        real = engine_ops._jitted_vmap
+        big_calls = []
+
+        def limited_vmap(g):
+            fn = real(g)
+
+            def wrapper(feed):
+                m = next(iter(feed.values())).shape[0]
+                if m > 4:
+                    big_calls.append(m)
+                    raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+                return fn(feed)
+
+            return wrapper
+
+        monkeypatch.setattr(engine_ops, "_jitted_vmap", limited_vmap)
+        df = TensorFrame.from_columns({"x": np.arange(20.0)})
+        out = tft.map_rows(lambda x: {"y": x * 2.0}, df).collect()
+        assert [r.y for r in out] == [float(2 * i) for i in range(20)]
+        assert big_calls  # the halving path actually fired

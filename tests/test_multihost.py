@@ -89,7 +89,14 @@ def two_process_result(tmp_path_factory):
         )
         for i in range(2)
     ]
-    outs = [p.communicate(timeout=300) for p in procs]
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    finally:
+        # a worker stuck in the distributed barrier (e.g. its peer died
+        # during initialize) must not outlive the fixture holding the port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
     line = next(

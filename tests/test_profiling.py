@@ -1,0 +1,55 @@
+"""Profiling hooks (the reference ships none — SURVEY §5)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.utils.profiling import Timer, block_until_ready, trace
+
+
+class TestTimer:
+    def test_sections_accumulate(self):
+        t = Timer()
+        with t.section("a"):
+            pass
+        with t.section("a"):
+            pass
+        with t.section("b"):
+            pass
+        assert t.counts == {"a": 2, "b": 1}
+        assert set(t.totals) == {"a", "b"}
+        assert all(v >= 0.0 for v in t.totals.values())
+
+    def test_section_sync_waits_on_device_work(self):
+        import jax.numpy as jnp
+
+        t = Timer()
+        x = jnp.arange(1024.0)
+        with t.section("matmul", sync=x):
+            y = x * 2.0
+        block_until_ready(y)
+        assert t.counts["matmul"] == 1
+
+    def test_report_format(self):
+        t = Timer()
+        with t.section("s"):
+            pass
+        rep = t.report()
+        assert "s:" in rep and "ms/call" in rep
+
+    def test_exception_still_recorded(self):
+        t = Timer()
+        with pytest.raises(ValueError):
+            with t.section("boom"):
+                raise ValueError("x")
+        assert t.counts["boom"] == 1
+
+
+class TestTrace:
+    def test_trace_writes_artifacts(self, tmp_path):
+        import jax.numpy as jnp
+
+        with trace(str(tmp_path)):
+            block_until_ready(jnp.arange(16.0).sum())
+        # jax writes a plugins/profile tree under the log dir
+        produced = list(tmp_path.rglob("*"))
+        assert produced, "profiler produced no artifacts"
