@@ -1,0 +1,94 @@
+"""Flash-attention microbench: Pallas kernel vs dense XLA attention.
+
+The long-context stack's hot op (the reference has no attention at all —
+SURVEY §5 "long context: absent"). Run on the attached backend:
+
+    python benchmarks/attention_bench.py [seq_lens...]
+
+Prints one JSON line per sequence length with ms/call and the achieved
+fraction of the dense oracle's time (higher speedup = better; dense
+attention materializes the [L, L] score matrix, flash streams K/V through
+VMEM so its memory stays O(L))."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_one(L, B=4, H=8, D=64, causal=True, iters=5):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.ops.attention import attention_reference, flash_attention
+
+    rng = np.random.default_rng(0)
+    shape = (B, H, L, D)
+    q = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+
+    # chain the op inside ONE jitted program (output feeds the next query)
+    # so per-dispatch link latency amortizes and the chip time dominates
+    chain = 10
+
+    def chained(attn):
+        def f(a, b, c):
+            def body(_, acc):
+                return attn(acc, b, c)
+
+            return jax.lax.fori_loop(0, chain, body, a)
+
+        return jax.jit(f)
+
+    flash1 = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=causal))
+    dense1 = jax.jit(lambda a, b, c: attention_reference(a, b, c, causal=causal))
+    flash = chained(lambda a, b, c: flash_attention(a, b, c, causal=causal))
+
+    out_f = jax.block_until_ready(flash1(q, k, v))
+    err = None
+    try:
+        out_d = jax.block_until_ready(dense1(q, k, v))
+        err = float(jnp.max(jnp.abs(out_f - out_d)))
+        dense = chained(
+            lambda a, b, c: attention_reference(a, b, c, causal=causal)
+        )
+        jax.block_until_ready(dense(q, k, v))
+    except Exception:
+        dense = None  # [L, L] score matrix no longer fits HBM
+
+    def timeit(f):
+        jax.block_until_ready(f(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            jax.block_until_ready(f(q, k, v))
+        return (time.perf_counter() - t0) / iters / chain
+
+    tf_ = timeit(flash)
+    td = timeit(dense) if dense is not None else None
+    # attention FLOPs: 2 matmuls of [L,L]x[L,D] per head (causal ~half)
+    flops = 4.0 * B * H * L * L * D * (0.5 if causal else 1.0)
+    return {
+        "metric": "flash_attention_ms",
+        "seq_len": L,
+        "batch": B,
+        "heads": H,
+        "head_dim": D,
+        "causal": causal,
+        "flash_ms": round(tf_ * 1e3, 3),
+        "dense_ms": round(td * 1e3, 3) if td else None,
+        "speedup_vs_dense": round(td / tf_, 3) if td else None,
+        "flash_tflops": round(flops / tf_ / 1e12, 2),
+        "max_abs_err_vs_dense": round(err, 6) if err is not None else None,
+    }
+
+
+def main():
+    lens = [int(a) for a in sys.argv[1:]] or [1024, 2048, 4096, 8192]
+    for L in lens:
+        print(json.dumps(bench_one(L)))
+
+
+if __name__ == "__main__":
+    main()

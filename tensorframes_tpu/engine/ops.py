@@ -71,9 +71,10 @@ row = _dsl.row
 
 #: per-callable CapturedGraph memo (see _graph_from_callable)
 _callable_graphs: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-#: (code, spec) signatures already captured once — used to warn on
-#: recompile churn from lambdas recreated per call
+#: (code, spec) signatures already captured once — used to warn (once per
+#: signature) on recompile churn from lambdas recreated per call
 _seen_callable_codes: set = set()
+_warned_callable_codes: set = set()
 
 
 # ---------------------------------------------------------------------------
@@ -188,13 +189,15 @@ def _graph_from_callable(
     ):
         code_key = (code, cache_key)
         if code_key in _seen_callable_codes:
-            logger.warning(
-                "capturing %s again for identical code — it is a new "
-                "function object each call, so compiled programs are not "
-                "reused; define the function once and pass the same object "
-                "to avoid recompilation",
-                getattr(fn, "__qualname__", fn),
-            )
+            if code_key not in _warned_callable_codes:
+                _warned_callable_codes.add(code_key)
+                logger.warning(
+                    "capturing %s again for identical code — it is a new "
+                    "function object each call, so compiled programs are "
+                    "not reused; define the function once and pass the "
+                    "same object to avoid recompilation",
+                    getattr(fn, "__qualname__", fn),
+                )
         elif len(_seen_callable_codes) < 4096:  # bounded diagnostic state
             _seen_callable_codes.add(code_key)
     probe_feed = None
@@ -544,16 +547,24 @@ def _map_rows_thunk(
             except Exception as e:
                 # rows are independent, so an OOM chunk is safe to halve
                 # (unlike a map_blocks partition); recurse down to 1 row
-                if is_oom(e) and len(sub) > 1:
-                    logger.warning(
-                        "map_rows chunk of %d rows exhausted device memory; "
-                        "halving", len(sub),
-                    )
-                    del feed
-                    mid = len(sub) // 2
-                    run_chunk(sub[:mid])
-                    run_chunk(sub[mid:])
-                    return
+                if is_oom(e):
+                    if len(sub) > 1:
+                        logger.warning(
+                            "map_rows chunk of %d rows exhausted device "
+                            "memory; halving", len(sub),
+                        )
+                        del feed
+                        mid = len(sub) // 2
+                        run_chunk(sub[:mid])
+                        run_chunk(sub[mid:])
+                        return
+                    from ..utils.failures import DeviceOOMError
+
+                    raise DeviceOOMError(
+                        "map_rows row program exhausted device memory even "
+                        "at one row per call; the per-row computation "
+                        "itself does not fit HBM"
+                    ) from e
                 raise
             for name in fetch_names:
                 arr = np.asarray(res[name])

@@ -63,8 +63,16 @@ class TestFlashAttention:
 
     def test_bad_block_size(self, nprng):
         q, k, v = qkv(nprng, l=30)
-        with pytest.raises(ValueError, match="multiples"):
+        with pytest.raises(ValueError, match="lane-aligned"):
             flash_attention(q, k, v, block_q=16, block_k=16)
+
+    def test_default_tiles_fit_non_multiple_lengths(self, nprng):
+        # L=640 is not a multiple of the 512/1024 default tiles but admits
+        # a 128 tile; default-argument callers must keep working
+        q, k, v = qkv(nprng, l=640)
+        out = flash_attention(q, k, v, causal=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
     def test_first_row_causal(self, nprng):
         # the first query attends only to itself: softmax over one key

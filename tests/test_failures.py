@@ -152,3 +152,15 @@ class TestEngineIntegration:
         out = tft.map_rows(lambda x: {"y": x * 2.0}, df).collect()
         assert [r.y for r in out] == [float(2 * i) for i in range(20)]
         assert big_calls  # the halving path actually fired
+
+    def test_map_rows_single_row_oom_is_typed(self, fast_retries, monkeypatch):
+        def always_oom(g):
+            def wrapper(feed):
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+            return wrapper
+
+        monkeypatch.setattr(engine_ops, "_jitted_vmap", always_oom)
+        df = TensorFrame.from_columns({"x": np.arange(4.0)})
+        with pytest.raises(DeviceOOMError, match="one row per call"):
+            tft.map_rows(lambda x: {"y": x * 2.0}, df).cache()
