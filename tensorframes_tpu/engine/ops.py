@@ -745,10 +745,14 @@ def _unpack_reduce_result(
     acc: Dict[str, Any], fetch_names: Sequence[str]
 ) -> Union[np.ndarray, List[np.ndarray]]:
     """Reference ``_unpack_row`` (``core.py:110-124``): numpy per fetch,
-    unwrapped when there is a single fetch."""
+    unwrapped when there is a single fetch. One batched device_get for all
+    fetches — per-fetch np.asarray would pay one host round-trip each."""
+    import jax
+
+    host = jax.device_get({f: acc[f] for f in fetch_names})
     vals = []
     for f in fetch_names:
-        a = np.asarray(acc[f])
+        a = np.asarray(host[f])
         vals.append(a if a.ndim > 0 else a[()])
     return vals[0] if len(vals) == 1 else vals
 
@@ -763,27 +767,58 @@ def reduce_blocks(fetches, dframe: TensorFrame):
     _ensure_precision(g, dframe.schema)
     jit_fn = _jitted(g)
     feeders = {}
+    any_streams = False
     for f, col in binding.items():
         dframe.column_block(col, None)  # rejects ragged/binary
-        feeders[f], _ = _block_feeder(dframe.column_data(col))
-    partials: List[Dict[str, Any]] = []
-    for p in range(dframe.num_partitions):
+        feeders[f], streams = _block_feeder(dframe.column_data(col))
+        any_streams = any_streams or streams
+    from ..utils import run_with_retries
+
+    def feed_for(p):
         lo, hi = dframe.partition_bounds()[p]
         if hi - lo == 0:
-            continue
-        feed = {f"{f}_input": feeders[f](lo, hi) for f in binding}
-        from ..utils import run_with_retries
+            return None
+        return {f"{f}_input": feeders[f](lo, hi) for f in binding}
 
-        def dispatch(_feed=feed):
+    if any_streams:
+        # a column exceeds the device cache budget and streams one block at
+        # a time — dispatch per partition with a sync each, so at most one
+        # block's buffers are live in HBM (the feeder's documented bound)
+        # and a transient failure retries only its own partition
+        partials: List[Dict[str, Any]] = []
+        for p in range(dframe.num_partitions):
+            feed = feed_for(p)
+            if feed is None:
+                continue
+
+            def dispatch(_feed=feed):
+                import jax
+
+                return jax.block_until_ready(jit_fn(_feed))
+
+            partials.append(
+                run_with_retries(
+                    dispatch, what=f"reduce_blocks partition {p}"
+                )
+            )
+    else:
+
+        def all_partials() -> List[Dict[str, Any]]:
             import jax
 
-            # sync inside the retry window (async failures would otherwise
-            # surface in the fold below); reduce is eager, partials are
-            # consumed immediately, so the sync is effectively free
-            return jax.block_until_ready(jit_fn(_feed))
+            ps = [
+                jit_fn(feed)
+                for feed in map(feed_for, range(dframe.num_partitions))
+                if feed is not None
+            ]
+            # device-cached feeds: dispatch every partition async, ONE sync
+            # for the group inside the retry window (per-partition syncing
+            # costs one host round-trip per partition; a group retry only
+            # re-runs compute, the transfers are memoized)
+            return jax.block_until_ready(ps)
 
-        partials.append(
-            run_with_retries(dispatch, what=f"reduce_blocks partition {p}")
+        partials = run_with_retries(
+            all_partials, what="reduce_blocks partials"
         )
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")

@@ -25,6 +25,10 @@ import numpy as np
 
 __all__ = ["kmeans", "assign_clusters"]
 
+#: per-column pre-aggregation functions (see kmeans(): capture is memoized
+#: by function identity, so the function object must be stable across calls)
+_pre_fn_cache: dict = {}
+
 
 def _pre_agg(features, centroids):
     """Per-block partials: [k, d] cluster sums and [k] counts, emitted as a
@@ -79,12 +83,17 @@ def kmeans(
     rng = np.random.default_rng(seed)
     centroids = data0[rng.choice(n, size=k, replace=False)].astype(data0.dtype)
 
-    # one function object for all iterations -> one captured graph -> one
-    # compiled program (centroids flow in as per-call constants)
-    pre_fn = _with_signature(
-        lambda **cols: _pre_agg(cols[col], cols["centroids"]),
-        [col, "centroids"],
-    )
+    # one function object per COLUMN NAME, cached at module scope: graph
+    # capture is memoized by function identity, so a fresh lambda per
+    # kmeans() call would re-capture (and re-trace) on every invocation —
+    # with the cache, repeated kmeans() calls (warmup, CV folds, demos)
+    # reuse one captured graph and one compiled program
+    pre_fn = _pre_fn_cache.get(col)
+    if pre_fn is None:
+        pre_fn = _pre_fn_cache[col] = _with_signature(
+            lambda **cols: _pre_agg(cols[col], cols["centroids"]),
+            [col, "centroids"],
+        )
 
     if distributed:
         from ..parallel import map_blocks, reduce_blocks

@@ -153,6 +153,21 @@ class TestEngineIntegration:
         assert [r.y for r in out] == [float(2 * i) for i in range(20)]
         assert big_calls  # the halving path actually fired
 
+    def test_reduce_blocks_streaming_path_correct(self, fast_retries):
+        # force the host-streaming feeder (column over the cache budget):
+        # reduce must take the per-partition sync path and stay correct
+        old = get_config().device_cache_bytes
+        set_config(device_cache_bytes=64)
+        try:
+            y = np.arange(40, dtype=np.float64).reshape(20, 2)
+            df = TensorFrame.from_columns({"y": y}, num_partitions=4).analyze()
+            s = tft.reduce_blocks(
+                lambda y_input: {"y": y_input.sum(axis=0)}, df
+            )
+            np.testing.assert_allclose(np.asarray(s), y.sum(axis=0))
+        finally:
+            set_config(device_cache_bytes=old)
+
     def test_map_rows_single_row_oom_is_typed(self, fast_retries, monkeypatch):
         def always_oom(g):
             def wrapper(feed):
