@@ -80,7 +80,12 @@ def _ln(x, p):
 def _attention(x, block, n_heads, causal, attn_impl, mesh):
     import jax.numpy as jnp
 
-    from ..ops import attention_reference, flash_attention, ring_attention
+    from ..ops import (
+        attention_reference,
+        flash_attention,
+        ring_attention,
+        ulysses_attention,
+    )
 
     bsz, length, d = x.shape
     hd = d // n_heads
@@ -93,6 +98,8 @@ def _attention(x, block, n_heads, causal, attn_impl, mesh):
     q, k, v = heads(q), heads(k), heads(v)
     if attn_impl == "ring":
         o = ring_attention(q, k, v, mesh=mesh, causal=causal)
+    elif attn_impl == "ulysses":
+        o = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
     elif attn_impl == "flash":
         o = flash_attention(q, k, v, causal=causal)
     else:
@@ -111,7 +118,14 @@ def transformer_logits(
     """``tokens`` [B, L] int32 -> logits [B, L, vocab].
 
     ``attn_impl``: "reference" (dense, XLA-fused — best for short L),
-    "flash" (Pallas kernel), or "ring" (sequence-parallel over ``mesh``)."""
+    "flash" (Pallas kernel), "ring" (K/V rotation over ``mesh``'s sp
+    axis), or "ulysses" (all-to-all head-sharding over the same axis;
+    needs heads divisible by the axis size)."""
+    if attn_impl not in ("reference", "flash", "ring", "ulysses"):
+        raise ValueError(
+            f"unknown attn_impl {attn_impl!r}; expected 'reference', "
+            f"'flash', 'ring', or 'ulysses'"
+        )
     import jax
     import jax.numpy as jnp
 

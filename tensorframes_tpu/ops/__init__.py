@@ -1,7 +1,9 @@
-"""Hot-op kernels: Pallas flash attention + ring sequence parallelism."""
+"""Hot-op kernels: Pallas flash attention + ring/Ulysses sequence
+parallelism."""
 
 from .attention import flash_attention, attention_reference, online_block_update
 from .ring import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
     "flash_attention",
@@ -9,4 +11,6 @@ __all__ = [
     "online_block_update",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
 ]

@@ -31,10 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .attention import _NEG_BIG, _finalize, online_block_update
+from .seq_common import SEQ_AXIS, check_divisible, resolve_sp_mesh
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
-
-SEQ_AXIS = "sp"
 
 
 def _local_ring_step(q, kc, vc, m, l, acc, q_off, k_off, causal, scale):
@@ -134,14 +133,9 @@ def ring_attention(
     """Full-array entry point: shards ``[B, H, L, D]`` inputs over the
     mesh's ``axis_name`` axis, runs the ring, and returns the assembled
     ``[B, H, L, D]`` output. ``L`` must divide by the axis size."""
-    if mesh is None:
-        from ..parallel.mesh import make_mesh
-
-        mesh = make_mesh({axis_name: len(jax.devices())})
-    n = mesh.shape[axis_name]
-    if q.shape[2] % n or k.shape[2] % n:
-        raise ValueError(
-            f"sequence length {q.shape[2]} must divide by the {axis_name} "
-            f"axis size {n}"
-        )
+    mesh = resolve_sp_mesh(mesh, axis_name)
+    check_divisible(
+        mesh.shape[axis_name], axis_name,
+        q_seq_len=q.shape[2], k_seq_len=k.shape[2],
+    )
     return _ring_program(mesh, causal, axis_name)(q, k, v)
