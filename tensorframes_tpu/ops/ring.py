@@ -31,7 +31,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from .attention import _NEG_BIG, _finalize, online_block_update
-from .seq_common import SEQ_AXIS, check_divisible, resolve_sp_mesh
+from .seq_common import (
+    SEQ_AXIS,
+    check_divisible,
+    pcast_varying,
+    resolve_sp_mesh,
+)
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
@@ -73,13 +78,7 @@ def ring_attention_sharded(
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def _vary(x):
-        # constants born inside shard_map are device-invariant; the loop
-        # carry becomes sp-varying after the first ppermute, so the initial
-        # carry must be marked varying too (jax >= 0.8 VMA checking)
-        try:
-            return jax.lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):
-            return x
+        return pcast_varying(x, axis_name)
 
     m0 = _vary(jnp.full((b, h, lq, 1), _NEG_BIG, dtype=jnp.float32))
     l0 = _vary(jnp.zeros((b, h, lq, 1), dtype=jnp.float32))

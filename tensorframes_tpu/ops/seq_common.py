@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-__all__ = ["SEQ_AXIS", "resolve_sp_mesh", "check_divisible"]
+__all__ = ["SEQ_AXIS", "resolve_sp_mesh", "check_divisible", "pcast_varying"]
 
 #: canonical sequence-parallel axis name
 SEQ_AXIS = "sp"
@@ -17,6 +17,22 @@ def resolve_sp_mesh(mesh, axis_name: str):
 
         mesh = make_mesh({axis_name: len(jax.devices())})
     return mesh
+
+
+def pcast_varying(t, axis_name: str):
+    """Mark a shard_map-internal constant as varying over ``axis_name``.
+
+    Constants born inside ``shard_map`` are device-invariant; a loop carry
+    that later passes through ``ppermute`` becomes varying, so the initial
+    carry must be marked too (jax >= 0.8 VMA checking). Older jax versions
+    lack ``pcast`` — there the check does not exist either, so pass-through
+    is correct."""
+    import jax
+
+    try:
+        return jax.lax.pcast(t, (axis_name,), to="varying")
+    except (AttributeError, TypeError):
+        return t
 
 
 def check_divisible(n: int, axis_name: str, **named_lengths: int) -> None:

@@ -1,0 +1,162 @@
+"""Expert parallelism: a mixture-of-experts FFN sharded over an ``ep`` axis.
+
+The reference has no model parallelism of any kind (SURVEY §2.5: "one graph
+replica per partition"); this module and :mod:`.pipeline` complete the mesh
+axes the TPU build treats as first-class (dp / tp / sp / ep / pp).
+
+Design, TPU-first: experts are sharded over ``ep`` — each chip holds
+``n_experts / n`` expert FFNs. Tokens stay replicated across the axis;
+every chip runs its local experts over all tokens with the router's
+one-hot mask folded into the expert output, and a single ``psum``
+combines the per-chip partials. Static shapes throughout — no
+capacity buffers, no token dropping, bit-identical to the dense oracle
+(the classic all-to-all token dispatch trades that exactness for lower
+FLOPs at high expert counts; with top-1 routing the masked compute is the
+robust default and the communication is one psum of ``[B, L, D]``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["init_moe", "moe_ffn", "moe_ffn_sharded", "moe_apply"]
+
+#: canonical expert-parallel axis name
+EXPERT_AXIS = "ep"
+
+Params = Dict[str, np.ndarray]
+
+
+def init_moe(
+    seed: int, d_model: int, d_ff: int, n_experts: int, dtype=np.float32
+) -> Params:
+    """Router + ``n_experts`` two-layer FFNs (stacked on a leading expert
+    axis so the expert dim shards cleanly over the mesh)."""
+    rng = np.random.default_rng(seed)
+
+    def dense(*shape, fan_in):
+        return rng.normal(0, fan_in**-0.5, shape).astype(dtype)
+
+    return {
+        "router": dense(d_model, n_experts, fan_in=d_model),
+        "w_up": dense(n_experts, d_model, d_ff, fan_in=d_model),
+        "b_up": np.zeros((n_experts, d_ff), dtype=dtype),
+        "w_down": dense(n_experts, d_ff, d_model, fan_in=d_ff),
+        "b_down": np.zeros((n_experts, d_model), dtype=dtype),
+    }
+
+
+def _expert_partials(params, x, expert_offset, gates, expert_ids):
+    """Sum of local experts' outputs over tokens routed to them.
+
+    ``x``: [B, L, D]; params hold the LOCAL expert slab (leading axis =
+    local expert count); ``expert_ids``/``gates``: [B, L] global top-1
+    routing. Masked compute: experts not chosen contribute zero."""
+    import jax
+    import jax.numpy as jnp
+
+    # jnp-ify once: the loop indexes the expert axis with a traced index,
+    # which raw numpy arrays cannot do
+    w_up_all = jnp.asarray(params["w_up"])
+    b_up_all = jnp.asarray(params["b_up"])
+    w_down_all = jnp.asarray(params["w_down"])
+    b_down_all = jnp.asarray(params["b_down"])
+
+    def one_expert(e_local, acc):
+        w_up = w_up_all[e_local]
+        b_up = b_up_all[e_local]
+        w_down = w_down_all[e_local]
+        b_down = b_down_all[e_local]
+        h = jax.nn.gelu(x @ w_up + b_up)
+        y = h @ w_down + b_down
+        mask = (expert_ids == e_local + expert_offset).astype(x.dtype)
+        return acc + y * (gates * mask)[..., None]
+
+    n_local = w_up_all.shape[0]
+    acc0 = jnp.zeros_like(x)
+    return jax.lax.fori_loop(
+        0, n_local, lambda e, a: one_expert(e, a), acc0
+    )
+
+
+def moe_ffn(params: Params, x):
+    """Dense oracle: top-1 routed MoE FFN, all experts local.
+    ``x``: [B, L, D] -> [B, L, D]."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = x @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_ids = jnp.argmax(probs, axis=-1)  # [B, L]
+    gates = jnp.max(probs, axis=-1)  # [B, L]
+    return _expert_partials(params, x, 0, gates, expert_ids)
+
+
+def moe_ffn_sharded(params: Params, x, axis_name: str = EXPERT_AXIS):
+    """Per-shard body (call inside ``shard_map``): params hold this chip's
+    expert slab (leading expert axis sharded over ``axis_name``), ``x`` is
+    replicated. Router runs replicated; local experts compute masked
+    partials; one ``psum`` combines."""
+    import jax
+    import jax.numpy as jnp
+
+    my = jax.lax.axis_index(axis_name)
+    n_local = params["w_up"].shape[0]
+
+    logits = x @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_ids = jnp.argmax(probs, axis=-1)
+    gates = jnp.max(probs, axis=-1)
+    partial = _expert_partials(
+        params, x, my * n_local, gates, expert_ids
+    )
+    return jax.lax.psum(partial, axis_name)
+
+
+@functools.lru_cache(maxsize=32)
+def _moe_program(mesh, axis_name: str):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    expert_sharded = {
+        "router": P(),  # replicated
+        "w_up": P(axis_name),
+        "b_up": P(axis_name),
+        "w_down": P(axis_name),
+        "b_down": P(axis_name),
+    }
+    return jax.jit(
+        jax.shard_map(
+            functools.partial(moe_ffn_sharded, axis_name=axis_name),
+            mesh=mesh,
+            in_specs=(expert_sharded, P()),
+            out_specs=P(),
+            # the masked-partial accumulator mixes replicated tokens with
+            # ep-varying expert slabs; the closing psum re-establishes
+            # replication, which is what the VMA checker cannot see
+            check_vma=False,
+        )
+    )
+
+
+def moe_apply(params: Params, x, mesh=None, axis_name: str = EXPERT_AXIS):
+    """Full-array entry point: shards the expert slabs over the mesh's
+    ``axis_name`` axis and applies the MoE FFN. ``n_experts`` must divide
+    by the axis size."""
+    import jax
+
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh({axis_name: len(jax.devices())})
+    n = mesh.shape[axis_name]
+    n_experts = params["w_up"].shape[0]
+    if n_experts % n:
+        raise ValueError(
+            f"n_experts={n_experts} must divide by the {axis_name!r} axis "
+            f"size {n}"
+        )
+    return _moe_program(mesh, axis_name)(params, x)
