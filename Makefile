@@ -1,0 +1,36 @@
+# Developer entry points (the reference ships sbt + python/run-tests.sh,
+# /root/reference/project/Build.scala:8-127, python/run-tests.sh:28-117).
+
+PY ?= python
+
+.PHONY: test test-multihost bench bench-all bench-attention dryrun install lint
+
+install:
+	$(PY) -m pip install -e . --no-build-isolation
+
+# full suite on a virtual 8-device CPU mesh (conftest forces the backend)
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# just the real 2-process distributed suite
+test-multihost:
+	$(PY) -m pytest tests/test_multihost.py -q
+
+# headline metric (one JSON line; targets the attached TPU)
+bench:
+	$(PY) bench.py
+
+# all BASELINE configs + extras
+bench-all:
+	$(PY) benchmarks/run_all.py
+
+bench-attention:
+	$(PY) benchmarks/attention_bench.py
+
+# the driver's multi-chip contract check (self-provisions 8 CPU devices)
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('DRYRUN OK')"
+
+# compile-check every module (no external linter in this environment)
+lint:
+	$(PY) -m compileall -q tensorframes_tpu benchmarks examples tests bench.py __graft_entry__.py

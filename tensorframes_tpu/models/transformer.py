@@ -77,7 +77,7 @@ def _ln(x, p):
     return (x - mu) / jnp.sqrt(var + 1e-5) * p["g"] + p["b"]
 
 
-def _attention(x, block, n_heads, causal, attn_impl, mesh):
+def _attention(x, block, n_heads, causal, attn_impl, mesh, batch_axis=None):
     import jax.numpy as jnp
 
     from ..ops import (
@@ -97,7 +97,9 @@ def _attention(x, block, n_heads, causal, attn_impl, mesh):
 
     q, k, v = heads(q), heads(k), heads(v)
     if attn_impl == "ring":
-        o = ring_attention(q, k, v, mesh=mesh, causal=causal)
+        o = ring_attention(
+            q, k, v, mesh=mesh, causal=causal, batch_axis=batch_axis
+        )
     elif attn_impl == "ulysses":
         o = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
     elif attn_impl == "flash":
@@ -114,6 +116,7 @@ def transformer_logits(
     causal: bool = True,
     attn_impl: str = "reference",
     mesh=None,
+    batch_axis=None,
 ):
     """``tokens`` [B, L] int32 -> logits [B, L, vocab].
 
@@ -138,7 +141,9 @@ def transformer_logits(
     x = embed[tokens] + pos[:length][None]
     for block in params["blocks"]:
         h = _ln(x, block["ln1"])
-        x = x + _attention(h, block, n_heads, causal, attn_impl, mesh)
+        x = x + _attention(
+            h, block, n_heads, causal, attn_impl, mesh, batch_axis
+        )
         h = _ln(x, block["ln2"])
         x = x + jax.nn.gelu(h @ block["up"]) @ block["down"]
     x = _ln(x, params["ln_f"])
@@ -146,7 +151,8 @@ def transformer_logits(
 
 
 def token_nll(
-    params: Params, tokens, attn_impl: str = "reference", mesh=None
+    params: Params, tokens, attn_impl: str = "reference", mesh=None,
+    batch_axis=None,
 ):
     """Per-position next-token negative log-likelihood ``[B, L-1]`` — the
     one implementation both training loss and frame scoring reduce over."""
@@ -154,7 +160,8 @@ def token_nll(
     import jax.numpy as jnp
 
     logits = transformer_logits(
-        params, tokens[:, :-1], causal=True, attn_impl=attn_impl, mesh=mesh
+        params, tokens[:, :-1], causal=True, attn_impl=attn_impl, mesh=mesh,
+        batch_axis=batch_axis,
     )
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -165,10 +172,13 @@ def token_nll(
 
 
 def transformer_loss(
-    params: Params, tokens, attn_impl: str = "reference", mesh=None
+    params: Params, tokens, attn_impl: str = "reference", mesh=None,
+    batch_axis=None,
 ):
     """Next-token cross entropy (mean over all predicted positions)."""
-    return token_nll(params, tokens, attn_impl=attn_impl, mesh=mesh).mean()
+    return token_nll(
+        params, tokens, attn_impl=attn_impl, mesh=mesh, batch_axis=batch_axis
+    ).mean()
 
 
 class TransformerLM:
@@ -198,6 +208,77 @@ class TransformerLM:
         p = {k: v for k, v in self.params.items() if k != "n_heads"}
         losses = []
         toks = np.asarray(tokens, dtype=np.int32)
+        for _ in range(steps):
+            p, loss = step(p, toks)
+            losses.append(float(loss))
+        self.params = {**jax.device_get(p), "n_heads": static}
+        return losses
+
+    def fit_sharded(
+        self,
+        tokens: np.ndarray,
+        mesh,
+        steps: int = 10,
+        lr: float = 0.1,
+        attn_impl: str = "ring",
+    ):
+        """One jitted SGD step over a ``dp x sp`` mesh: batch rows sharded
+        over ``dp``, attention sequence-parallel over ``sp`` (ring K/V
+        rotation with ``batch_axis="dp"`` — both axes live in the SAME
+        program, so GSPMD inserts the gradient all-reduce over dp around
+        the ring's ppermute hops over sp).
+
+        Constraint from the loss shift: the attention runs on ``L - 1``
+        positions, so ``tokens.shape[1] - 1`` must divide by the sp axis
+        size (and the batch by the dp size)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if set(mesh.axis_names) != {"dp", "sp"}:
+            raise ValueError(
+                f"fit_sharded needs a ('dp','sp') mesh; got {mesh.axis_names}"
+            )
+        if attn_impl != "ring":
+            # ulysses/flash lower through pallas, whose JVP rule cannot be
+            # differentiated here; and only the ring path composes with a
+            # sharded batch axis today
+            raise ValueError(
+                f"fit_sharded supports attn_impl='ring' only; got "
+                f"{attn_impl!r}"
+            )
+        b, length = tokens.shape
+        if b % mesh.shape["dp"] or (length - 1) % mesh.shape["sp"]:
+            raise ValueError(
+                f"batch {b} must divide by dp={mesh.shape['dp']} and "
+                f"L-1={length - 1} by sp={mesh.shape['sp']}"
+            )
+        static = self.params["n_heads"]
+
+        def loss_fn(p, toks):
+            return transformer_loss(
+                {**p, "n_heads": static},
+                toks,
+                attn_impl=attn_impl,
+                mesh=mesh,
+                batch_axis="dp",
+            )
+
+        rep = NamedSharding(mesh, P())
+        p = {k: v for k, v in self.params.items() if k != "n_heads"}
+
+        def step(p, toks):
+            loss, grads = jax.value_and_grad(loss_fn)(p, toks)
+            new_p = jax.tree.map(lambda a, g: a - lr * g, p, grads)
+            return new_p, loss
+
+        step = jax.jit(
+            step, out_shardings=(jax.tree.map(lambda _: rep, p), None)
+        )
+        toks = jax.device_put(
+            np.asarray(tokens, dtype=np.int32),
+            NamedSharding(mesh, P("dp", None)),
+        )
+        losses = []
         for _ in range(steps):
             p, loss = step(p, toks)
             losses.append(float(loss))

@@ -66,6 +66,7 @@ def ring_attention_sharded(
     v: jnp.ndarray,
     causal: bool = False,
     axis_name: str = SEQ_AXIS,
+    batch_axis=None,
 ):
     """The per-shard body: call inside ``shard_map`` with q/k/v sequence
     chunks ``[B, H, L/n, D]`` sharded over ``axis_name``. Returns the local
@@ -78,7 +79,12 @@ def ring_attention_sharded(
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def _vary(x):
-        return pcast_varying(x, axis_name)
+        # the carry inherits q's variance: sp always, plus the batch axis
+        # when the batch dim is sharded too (dp x sp composition)
+        x = pcast_varying(x, axis_name)
+        if batch_axis is not None:
+            x = pcast_varying(x, batch_axis)
+        return x
 
     m0 = _vary(jnp.full((b, h, lq, 1), _NEG_BIG, dtype=jnp.float32))
     l0 = _vary(jnp.zeros((b, h, lq, 1), dtype=jnp.float32))
@@ -102,17 +108,20 @@ def ring_attention_sharded(
 
 
 @functools.lru_cache(maxsize=64)
-def _ring_program(mesh, causal: bool, axis_name: str):
+def _ring_program(mesh, causal: bool, axis_name: str, batch_axis=None):
     """One jitted shard_map program per (mesh, causal, axis) — cached so
     repeated calls (every transformer layer, every step) hit the jit cache
     instead of retracing."""
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
     return jax.jit(
         jax.shard_map(
             functools.partial(
-                ring_attention_sharded, causal=causal, axis_name=axis_name
+                ring_attention_sharded,
+                causal=causal,
+                axis_name=axis_name,
+                batch_axis=batch_axis,
             ),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -128,13 +137,26 @@ def ring_attention(
     mesh=None,
     causal: bool = False,
     axis_name: str = SEQ_AXIS,
+    batch_axis=None,
 ):
     """Full-array entry point: shards ``[B, H, L, D]`` inputs over the
     mesh's ``axis_name`` axis, runs the ring, and returns the assembled
-    ``[B, H, L, D]`` output. ``L`` must divide by the axis size."""
+    ``[B, H, L, D]`` output. ``L`` must divide by the axis size.
+    ``batch_axis`` additionally shards the batch dim over another mesh
+    axis (dp x sp composition in one program; the ring body is batch-
+    agnostic, so only the specs change)."""
     mesh = resolve_sp_mesh(mesh, axis_name)
     check_divisible(
         mesh.shape[axis_name], axis_name,
         q_seq_len=q.shape[2], k_seq_len=k.shape[2],
     )
-    return _ring_program(mesh, causal, axis_name)(q, k, v)
+    if batch_axis is not None:
+        if batch_axis not in mesh.shape:
+            raise ValueError(
+                f"batch_axis {batch_axis!r} is not a mesh axis; mesh has "
+                f"{tuple(mesh.shape)}"
+            )
+        check_divisible(
+            mesh.shape[batch_axis], batch_axis, batch=q.shape[0]
+        )
+    return _ring_program(mesh, causal, axis_name, batch_axis)(q, k, v)
