@@ -27,12 +27,20 @@ __all__ = [
     "gather_rows",
     "scatter_rows",
     "gather_ragged_pad",
+    "set_native_threads",
+    "native_threads",
 ]
+
+#: outputs larger than this route to the native thread-pool executor
+#: (native/executor.cpp); smaller ones stay single-threaded — splitting
+#: costs more than it saves under ~a few MB
+_PAR_THRESHOLD_BYTES = 8 << 20
 
 logger = get_logger("data.packer")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "packer.cpp")
+_SRC_EXEC = os.path.join(_NATIVE_DIR, "executor.cpp")
 _LIB = os.path.join(_NATIVE_DIR, "libtfspacker.so")
 
 _lock = threading.Lock()
@@ -41,7 +49,7 @@ _tried = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, _SRC_EXEC, "-o", _LIB]
     try:
         res = subprocess.run(
             cmd, capture_output=True, text=True, timeout=120
@@ -63,7 +71,9 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < max(
+            os.path.getmtime(_SRC), os.path.getmtime(_SRC_EXEC)
+        ):
             if not _build():
                 return None
         try:
@@ -74,6 +84,18 @@ def _load() -> Optional[ctypes.CDLL]:
         c_char_p = ctypes.c_char_p
         c_i64 = ctypes.c_int64
         p_i64 = ctypes.POINTER(ctypes.c_int64)
+        # ABI gate FIRST: a stale library must fall back to numpy with a
+        # warning, not crash on a missing tfs_par_* symbol below
+        try:
+            lib.tfs_packer_abi_version.restype = c_i64
+            abi = lib.tfs_packer_abi_version()
+        except AttributeError:
+            abi = -1
+        if abi != 2:
+            logger.warning(
+                "native packer ABI %s != 2; using numpy fallback", abi
+            )
+            return None
         lib.tfs_pad_ragged.argtypes = [
             c_char_p, p_i64, c_i64, c_i64, c_i64, c_char_p, c_char_p,
         ]
@@ -85,16 +107,39 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tfs_gather_ragged_pad.argtypes = [
             c_char_p, p_i64, p_i64, c_i64, c_i64, c_i64, c_char_p, c_char_p,
         ]
-        lib.tfs_packer_abi_version.restype = c_i64
-        if lib.tfs_packer_abi_version() != 1:
-            logger.warning("native packer ABI mismatch; using numpy fallback")
-            return None
+        lib.tfs_par_gather_rows.argtypes = lib.tfs_gather_rows.argtypes
+        lib.tfs_par_scatter_rows.argtypes = lib.tfs_scatter_rows.argtypes
+        lib.tfs_par_pad_ragged.argtypes = lib.tfs_pad_ragged.argtypes
+        lib.tfs_par_gather_ragged_pad.argtypes = (
+            lib.tfs_gather_ragged_pad.argtypes
+        )
+        lib.tfs_executor_set_threads.argtypes = [c_i64]
+        lib.tfs_executor_set_threads.restype = c_i64
+        lib.tfs_executor_threads.restype = c_i64
         _lib = lib
         return _lib
 
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def set_native_threads(n: int) -> int:
+    """Size the native executor pool (0 = auto: hardware up to 16).
+    Takes effect on the pool's next (re)creation; returns the previous
+    setting. No-op (returns 0) without the native library."""
+    lib = _load()
+    if lib is None:
+        return 0
+    return int(lib.tfs_executor_set_threads(int(n)))
+
+
+def native_threads() -> int:
+    """The executor pool's active size (incl. the calling thread)."""
+    lib = _load()
+    if lib is None:
+        return 1
+    return int(lib.tfs_executor_threads())
 
 
 def _ptr(a: np.ndarray):
@@ -142,7 +187,12 @@ def pad_ragged(
     lib = _load()
     pad = np.asarray(pad_value, dtype=flat.dtype)
     if lib is not None:
-        lib.tfs_pad_ragged(
+        fn = (
+            lib.tfs_par_pad_ragged
+            if out.nbytes >= _PAR_THRESHOLD_BYTES
+            else lib.tfs_pad_ragged
+        )
+        fn(
             _ptr(flat), _i64ptr(offsets), n, ml, flat.dtype.itemsize,
             _ptr(pad.reshape(1)), _ptr(out),
         )
@@ -203,7 +253,12 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     lib = _load()
     if lib is not None and src.ndim >= 1:
         row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.dtype.itemsize
-        lib.tfs_gather_rows(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))
+        fn = (
+            lib.tfs_par_gather_rows
+            if out.nbytes >= _PAR_THRESHOLD_BYTES
+            else lib.tfs_gather_rows
+        )
+        fn(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))
         return out
     return src[idx]
 
@@ -220,7 +275,15 @@ def scatter_rows(src: np.ndarray, idx: np.ndarray, n_rows: int) -> np.ndarray:
     lib = _load()
     if lib is not None:
         row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.dtype.itemsize
-        lib.tfs_scatter_rows(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))
+        # the pooled scatter would race on duplicate targets (the serial
+        # kernel is deterministic last-wins), so it is reserved for
+        # permutation-like unique indices
+        fn = lib.tfs_scatter_rows
+        if out.nbytes >= _PAR_THRESHOLD_BYTES and len(
+            np.unique(idx)
+        ) == len(idx):
+            fn = lib.tfs_par_scatter_rows
+        fn(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))
         return out
     out[idx] = src
     return out
@@ -253,7 +316,12 @@ def gather_ragged_pad(
     lib = _load()
     pad = np.asarray(pad_value, dtype=flat.dtype)
     if lib is not None:
-        lib.tfs_gather_ragged_pad(
+        fn = (
+            lib.tfs_par_gather_ragged_pad
+            if out.nbytes >= _PAR_THRESHOLD_BYTES
+            else lib.tfs_gather_ragged_pad
+        )
+        fn(
             _ptr(flat), _i64ptr(offsets), _i64ptr(idx), len(idx),
             int(max_len), flat.dtype.itemsize, _ptr(pad.reshape(1)), _ptr(out),
         )

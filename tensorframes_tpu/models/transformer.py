@@ -191,28 +191,41 @@ class TransformerLM:
     def init(seed: int, vocab: int, **kw) -> "TransformerLM":
         return TransformerLM(init_transformer(seed, vocab, **kw))
 
-    def fit(self, tokens: np.ndarray, steps: int = 10, lr: float = 0.1):
-        """Plain jitted SGD on next-token loss (single chip)."""
+    def _sgd_loop(
+        self, tokens, steps, lr, loss_kwargs, jit_kwargs=None, place=None
+    ):
+        """Shared SGD machinery for :meth:`fit` and :meth:`fit_sharded`:
+        jitted value_and_grad step, loop, params reassembly. ``loss_kwargs``
+        feed :func:`transformer_loss`; ``jit_kwargs`` (e.g. out_shardings)
+        configure the jit; ``place`` maps host tokens to device."""
         import jax
 
         static = self.params["n_heads"]
-
-        def loss_fn(p, toks):
-            return transformer_loss({**p, "n_heads": static}, toks)
-
-        @jax.jit
-        def step(p, toks):
-            loss, g = jax.value_and_grad(loss_fn)(p, toks)
-            return jax.tree.map(lambda a, b: a - lr * b, p, g), loss
-
         p = {k: v for k, v in self.params.items() if k != "n_heads"}
-        losses = []
+
+        def loss_fn(p_, toks_):
+            return transformer_loss(
+                {**p_, "n_heads": static}, toks_, **loss_kwargs
+            )
+
+        def step(p_, toks_):
+            loss, grads = jax.value_and_grad(loss_fn)(p_, toks_)
+            return jax.tree.map(lambda a, g: a - lr * g, p_, grads), loss
+
+        step = jax.jit(step, **(jit_kwargs(p) if jit_kwargs else {}))
         toks = np.asarray(tokens, dtype=np.int32)
+        if place is not None:
+            toks = place(toks)
+        losses = []
         for _ in range(steps):
             p, loss = step(p, toks)
             losses.append(float(loss))
         self.params = {**jax.device_get(p), "n_heads": static}
         return losses
+
+    def fit(self, tokens: np.ndarray, steps: int = 10, lr: float = 0.1):
+        """Plain jitted SGD on next-token loss (single chip)."""
+        return self._sgd_loop(tokens, steps, lr, loss_kwargs={})
 
     def fit_sharded(
         self,
@@ -252,38 +265,21 @@ class TransformerLM:
                 f"batch {b} must divide by dp={mesh.shape['dp']} and "
                 f"L-1={length - 1} by sp={mesh.shape['sp']}"
             )
-        static = self.params["n_heads"]
-
-        def loss_fn(p, toks):
-            return transformer_loss(
-                {**p, "n_heads": static},
-                toks,
-                attn_impl=attn_impl,
-                mesh=mesh,
-                batch_axis="dp",
-            )
-
         rep = NamedSharding(mesh, P())
-        p = {k: v for k, v in self.params.items() if k != "n_heads"}
-
-        def step(p, toks):
-            loss, grads = jax.value_and_grad(loss_fn)(p, toks)
-            new_p = jax.tree.map(lambda a, g: a - lr * g, p, grads)
-            return new_p, loss
-
-        step = jax.jit(
-            step, out_shardings=(jax.tree.map(lambda _: rep, p), None)
+        return self._sgd_loop(
+            tokens,
+            steps,
+            lr,
+            loss_kwargs=dict(
+                attn_impl=attn_impl, mesh=mesh, batch_axis="dp"
+            ),
+            jit_kwargs=lambda p: dict(
+                out_shardings=(jax.tree.map(lambda _: rep, p), None)
+            ),
+            place=lambda t: jax.device_put(
+                t, NamedSharding(mesh, P("dp", None))
+            ),
         )
-        toks = jax.device_put(
-            np.asarray(tokens, dtype=np.int32),
-            NamedSharding(mesh, P("dp", None)),
-        )
-        losses = []
-        for _ in range(steps):
-            p, loss = step(p, toks)
-            losses.append(float(loss))
-        self.params = {**jax.device_get(p), "n_heads": static}
-        return losses
 
     def score_frame(
         self, df, col: str, loss_col: str = "nll", attn_impl: str = "reference"

@@ -1,0 +1,241 @@
+// Native executor: a persistent thread pool running the packer kernels in
+// parallel for large columns.
+//
+// The reference's runtime-side concurrency lives in Spark's task executor
+// (tasks scheduled across JVM worker threads); here the engine is a single
+// Python process, so the native layer carries its own pool. Kernels are
+// pure byte movement with disjoint output ranges per row, so row-range
+// splitting is race-free by construction. The pool is created lazily on
+// first use and sized to the hardware (capped), overridable for tests.
+//
+// Build: compiled together with packer.cpp into libtfspacker.so (see
+// tensorframes_tpu/data/packer.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+class Pool {
+ public:
+  explicit Pool(int n) : stop_(false), pending_(0) {
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] { Work(); });
+    }
+  }
+
+  ~Pool() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  int size() const { return static_cast<int>(workers_.size()); }
+
+  // run fn(chunk_begin, chunk_end) over [0, n) split across the pool and
+  // the calling thread; returns when every chunk is done
+  void ParallelFor(int64_t n, int64_t min_chunk,
+                   const std::function<void(int64_t, int64_t)>& fn) {
+    const int workers = size() + 1;  // + calling thread
+    int64_t chunks = (n + min_chunk - 1) / min_chunk;
+    if (chunks > workers) chunks = workers;
+    if (chunks <= 1) {
+      fn(0, n);
+      return;
+    }
+    const int64_t per = (n + chunks - 1) / chunks;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      for (int64_t c = 1; c < chunks; ++c) {
+        const int64_t b = c * per;
+        const int64_t e = std::min(n, b + per);
+        if (b >= e) continue;
+        ++pending_;
+        tasks_.push([fn, b, e] { fn(b, e); });
+      }
+    }
+    cv_.notify_all();
+    fn(0, std::min(n, per));  // calling thread takes the first chunk
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this] { return pending_ == 0; });
+  }
+
+ private:
+  void Work() {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !tasks_.empty(); });
+        if (stop_ && tasks_.empty()) return;
+        task = std::move(tasks_.front());
+        tasks_.pop();
+      }
+      task();
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (--pending_ == 0) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::queue<std::function<void()>> tasks_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  bool stop_;
+  int64_t pending_;
+};
+
+std::mutex g_pool_mu;
+std::condition_variable g_idle_cv;
+Pool* g_pool = nullptr;
+int g_threads = 0;  // 0 = auto
+int g_in_use = 0;
+
+Pool* GetPoolLocked() {
+  if (g_pool == nullptr) {
+    int n = g_threads;
+    if (n <= 0) {
+      n = static_cast<int>(std::thread::hardware_concurrency());
+      if (n > 16) n = 16;
+      if (n < 1) n = 1;
+    }
+    g_pool = new Pool(n - 1);  // calling thread participates
+  }
+  return g_pool;
+}
+
+// RAII pool lease: set_threads must not delete the pool out from under a
+// concurrent ParallelFor (ctypes releases the GIL, so concurrent native
+// calls are real); the lease counter makes the swap wait for idle.
+class PoolLease {
+ public:
+  PoolLease() {
+    std::unique_lock<std::mutex> lk(g_pool_mu);
+    pool_ = GetPoolLocked();
+    ++g_in_use;
+  }
+  ~PoolLease() {
+    std::unique_lock<std::mutex> lk(g_pool_mu);
+    if (--g_in_use == 0) g_idle_cv.notify_all();
+  }
+  Pool* operator->() { return pool_; }
+
+ private:
+  Pool* pool_;
+};
+
+//: below this many bytes per chunk, splitting costs more than it saves
+constexpr int64_t kMinChunkBytes = 1 << 20;
+
+}  // namespace
+
+extern "C" {
+
+// set the pool size BEFORE first use (tests); 0 restores auto sizing.
+// Returns the previously configured value.
+int64_t tfs_executor_set_threads(int64_t n) {
+  std::unique_lock<std::mutex> lk(g_pool_mu);
+  g_idle_cv.wait(lk, [] { return g_in_use == 0; });  // drain active leases
+  const int64_t old = g_threads;
+  g_threads = static_cast<int>(n);
+  delete g_pool;
+  g_pool = nullptr;
+  return old;
+}
+
+int64_t tfs_executor_threads() {
+  PoolLease pool;
+  return pool->size() + 1;
+}
+
+// parallel variants of the packer kernels: identical semantics, row
+// ranges split across the pool (outputs are disjoint per row)
+
+void tfs_par_gather_rows(const char* src, int64_t row_bytes,
+                         const int64_t* idx, int64_t n_idx, char* out) {
+  const int64_t min_rows = kMinChunkBytes / (row_bytes ? row_bytes : 1) + 1;
+  PoolLease pool;
+  pool->ParallelFor(n_idx, min_rows, [&](int64_t b, int64_t e) {
+    for (int64_t k = b; k < e; ++k) {
+      std::memcpy(out + k * row_bytes, src + idx[k] * row_bytes, row_bytes);
+    }
+  });
+}
+
+void tfs_par_scatter_rows(const char* src, int64_t row_bytes,
+                          const int64_t* idx, int64_t n_idx, char* out) {
+  const int64_t min_rows = kMinChunkBytes / (row_bytes ? row_bytes : 1) + 1;
+  PoolLease pool;
+  pool->ParallelFor(n_idx, min_rows, [&](int64_t b, int64_t e) {
+    for (int64_t k = b; k < e; ++k) {
+      std::memcpy(out + idx[k] * row_bytes, src + k * row_bytes, row_bytes);
+    }
+  });
+}
+
+void tfs_par_pad_ragged(const char* flat, const int64_t* offsets,
+                        int64_t n_rows, int64_t max_len, int64_t elem_size,
+                        const char* pad_elem, char* out) {
+  const int64_t row_bytes = max_len * elem_size;
+  const int64_t min_rows = kMinChunkBytes / (row_bytes ? row_bytes : 1) + 1;
+  PoolLease pool;
+  pool->ParallelFor(n_rows, min_rows, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      const int64_t len = offsets[i + 1] - offsets[i];
+      char* dst = out + i * row_bytes;
+      std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
+      const int64_t pad_count = max_len - len;
+      if (pad_count <= 0) continue;
+      char* pad_dst = dst + len * elem_size;
+      if (pad_elem == nullptr) {
+        std::memset(pad_dst, 0, pad_count * elem_size);
+      } else {
+        for (int64_t j = 0; j < pad_count; ++j) {
+          std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
+        }
+      }
+    }
+  });
+}
+
+void tfs_par_gather_ragged_pad(const char* flat, const int64_t* offsets,
+                               const int64_t* idx, int64_t n_idx,
+                               int64_t max_len, int64_t elem_size,
+                               const char* pad_elem, char* out) {
+  const int64_t row_bytes = max_len * elem_size;
+  const int64_t min_rows = kMinChunkBytes / (row_bytes ? row_bytes : 1) + 1;
+  PoolLease pool;
+  pool->ParallelFor(n_idx, min_rows, [&](int64_t b, int64_t e) {
+    for (int64_t k = b; k < e; ++k) {
+      const int64_t i = idx[k];
+      const int64_t len = offsets[i + 1] - offsets[i];
+      char* dst = out + k * row_bytes;
+      std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
+      const int64_t pad_count = max_len - len;
+      if (pad_count <= 0) continue;
+      char* pad_dst = dst + len * elem_size;
+      if (pad_elem == nullptr) {
+        std::memset(pad_dst, 0, pad_count * elem_size);
+      } else {
+        for (int64_t j = 0; j < pad_count; ++j) {
+          std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
+        }
+      }
+    }
+  });
+}
+
+}  // extern "C"

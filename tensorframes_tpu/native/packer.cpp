@@ -123,6 +123,6 @@ void tfs_scatter_rows(const char* src,
   }
 }
 
-int64_t tfs_packer_abi_version() { return 1; }
+int64_t tfs_packer_abi_version() { return 2; }
 
 }  // extern "C"

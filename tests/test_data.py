@@ -213,3 +213,53 @@ class TestOffsetsValidation:
                 np.array([0]),
                 4,
             )
+
+
+class TestNativeExecutor:
+    """Thread-pool variants of the packer kernels (native/executor.cpp).
+    Row ranges have disjoint outputs, so pooled results must be
+    bit-identical to the serial kernels at any thread count."""
+
+    def test_pooled_matches_serial(self):
+        from tensorframes_tpu.data import packer as P
+
+        if not P.native_available():
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(0)
+        old_thresh = P._PAR_THRESHOLD_BYTES
+        P._PAR_THRESHOLD_BYTES = 1  # force the pooled path
+        P.set_native_threads(4)
+        try:
+            assert P.native_threads() == 4
+            src = rng.normal(size=(500, 8)).astype(np.float32)
+            idx = rng.permutation(500).astype(np.int64)
+            np.testing.assert_array_equal(P.gather_rows(src, idx), src[idx])
+            back = P.scatter_rows(src[idx], idx, 500)
+            np.testing.assert_array_equal(back, src)
+
+            lens = rng.integers(0, 9, size=300)
+            offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+            flat = rng.normal(size=int(offsets[-1])).astype(np.float64)
+            padded = P.pad_ragged(flat, offsets, pad_value=-1.0)
+            for i in range(300):
+                row = flat[offsets[i]:offsets[i + 1]]
+                np.testing.assert_array_equal(padded[i, :len(row)], row)
+                assert (padded[i, len(row):] == -1.0).all()
+            sel = rng.integers(0, 300, size=64).astype(np.int64)
+            g = P.gather_ragged_pad(flat, offsets, sel, int(lens.max()))
+            for k, i in enumerate(sel):
+                row = flat[offsets[i]:offsets[i + 1]]
+                np.testing.assert_array_equal(g[k, :len(row)], row)
+        finally:
+            P._PAR_THRESHOLD_BYTES = old_thresh
+            P.set_native_threads(0)
+
+    def test_set_threads_roundtrip(self):
+        from tensorframes_tpu.data import packer as P
+
+        if not P.native_available():
+            pytest.skip("no native toolchain")
+        P.set_native_threads(2)
+        assert P.native_threads() == 2
+        P.set_native_threads(0)
+        assert P.native_threads() >= 1
