@@ -277,11 +277,13 @@ def scatter_rows(src: np.ndarray, idx: np.ndarray, n_rows: int) -> np.ndarray:
         row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.dtype.itemsize
         # the pooled scatter would race on duplicate targets (the serial
         # kernel is deterministic last-wins), so it is reserved for
-        # permutation-like unique indices
+        # permutation-like unique indices — checked in O(n) via bincount
+        # (a sort-based uniqueness test would cost more than the copy)
         fn = lib.tfs_scatter_rows
-        if out.nbytes >= _PAR_THRESHOLD_BYTES and len(
-            np.unique(idx)
-        ) == len(idx):
+        if out.nbytes >= _PAR_THRESHOLD_BYTES and (
+            len(idx) == 0
+            or int(np.bincount(idx, minlength=n_rows).max()) <= 1
+        ):
             fn = lib.tfs_par_scatter_rows
         fn(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))
         return out

@@ -3,29 +3,31 @@
 //
 // The reference's runtime-side concurrency lives in Spark's task executor
 // (tasks scheduled across JVM worker threads); here the engine is a single
-// Python process, so the native layer carries its own pool. Kernels are
-// pure byte movement with disjoint output ranges per row, so row-range
-// splitting is race-free by construction. The pool is created lazily on
-// first use and sized to the hardware (capped), overridable for tests.
+// Python process, so the native layer carries its own pool. Kernel bodies
+// live in kernels.h (shared with the serial entry points in packer.cpp);
+// outputs are disjoint per row, so row-range splitting is race-free. The
+// pool is created lazily, sized to the hardware (capped), overridable for
+// tests; completion is tracked PER INVOCATION so concurrent callers
+// (ctypes releases the GIL) never wait on each other's work.
 //
 // Build: compiled together with packer.cpp into libtfspacker.so (see
 // tensorframes_tpu/data/packer.py).
 
-#include <atomic>
 #include <condition_variable>
 #include <cstdint>
-#include <cstring>
 #include <functional>
 #include <mutex>
 #include <queue>
 #include <thread>
 #include <vector>
 
+#include "kernels.h"
+
 namespace {
 
 class Pool {
  public:
-  explicit Pool(int n) : stop_(false), pending_(0) {
+  explicit Pool(int n) : stop_(false) {
     for (int i = 0; i < n; ++i) {
       workers_.emplace_back([this] { Work(); });
     }
@@ -43,7 +45,8 @@ class Pool {
   int size() const { return static_cast<int>(workers_.size()); }
 
   // run fn(chunk_begin, chunk_end) over [0, n) split across the pool and
-  // the calling thread; returns when every chunk is done
+  // the calling thread; returns when THIS invocation's chunks are done
+  // (other invocations may be in flight on the same pool)
   void ParallelFor(int64_t n, int64_t min_chunk,
                    const std::function<void(int64_t, int64_t)>& fn) {
     const int workers = size() + 1;  // + calling thread
@@ -53,6 +56,11 @@ class Pool {
       fn(0, n);
       return;
     }
+    struct Invocation {
+      std::mutex m;
+      std::condition_variable done;
+      int64_t remaining = 0;
+    } inv;
     const int64_t per = (n + chunks - 1) / chunks;
     {
       std::unique_lock<std::mutex> lk(mu_);
@@ -60,14 +68,21 @@ class Pool {
         const int64_t b = c * per;
         const int64_t e = std::min(n, b + per);
         if (b >= e) continue;
-        ++pending_;
-        tasks_.push([fn, b, e] { fn(b, e); });
+        {
+          std::unique_lock<std::mutex> ilk(inv.m);
+          ++inv.remaining;
+        }
+        tasks_.push([&fn, &inv, b, e] {
+          fn(b, e);
+          std::unique_lock<std::mutex> ilk(inv.m);
+          if (--inv.remaining == 0) inv.done.notify_one();
+        });
       }
     }
     cv_.notify_all();
     fn(0, std::min(n, per));  // calling thread takes the first chunk
-    std::unique_lock<std::mutex> lk(mu_);
-    done_cv_.wait(lk, [this] { return pending_ == 0; });
+    std::unique_lock<std::mutex> ilk(inv.m);
+    inv.done.wait(ilk, [&inv] { return inv.remaining == 0; });
   }
 
  private:
@@ -82,10 +97,6 @@ class Pool {
         tasks_.pop();
       }
       task();
-      {
-        std::unique_lock<std::mutex> lk(mu_);
-        if (--pending_ == 0) done_cv_.notify_all();
-      }
     }
   }
 
@@ -93,9 +104,7 @@ class Pool {
   std::queue<std::function<void()>> tasks_;
   std::mutex mu_;
   std::condition_variable cv_;
-  std::condition_variable done_cv_;
   bool stop_;
-  int64_t pending_;
 };
 
 std::mutex g_pool_mu;
@@ -140,15 +149,19 @@ class PoolLease {
 //: below this many bytes per chunk, splitting costs more than it saves
 constexpr int64_t kMinChunkBytes = 1 << 20;
 
+inline int64_t MinRows(int64_t row_bytes) {
+  return kMinChunkBytes / (row_bytes ? row_bytes : 1) + 1;
+}
+
 }  // namespace
 
 extern "C" {
 
-// set the pool size BEFORE first use (tests); 0 restores auto sizing.
-// Returns the previously configured value.
+// resize the pool (0 restores auto sizing); waits for in-flight kernels
+// to drain before swapping. Returns the previously configured value.
 int64_t tfs_executor_set_threads(int64_t n) {
   std::unique_lock<std::mutex> lk(g_pool_mu);
-  g_idle_cv.wait(lk, [] { return g_in_use == 0; });  // drain active leases
+  g_idle_cv.wait(lk, [] { return g_in_use == 0; });
   const int64_t old = g_threads;
   g_threads = static_cast<int>(n);
   delete g_pool;
@@ -161,81 +174,45 @@ int64_t tfs_executor_threads() {
   return pool->size() + 1;
 }
 
-// parallel variants of the packer kernels: identical semantics, row
-// ranges split across the pool (outputs are disjoint per row)
+// parallel entry points: one shared kernel body each (kernels.h)
 
 void tfs_par_gather_rows(const char* src, int64_t row_bytes,
                          const int64_t* idx, int64_t n_idx, char* out) {
-  const int64_t min_rows = kMinChunkBytes / (row_bytes ? row_bytes : 1) + 1;
   PoolLease pool;
-  pool->ParallelFor(n_idx, min_rows, [&](int64_t b, int64_t e) {
-    for (int64_t k = b; k < e; ++k) {
-      std::memcpy(out + k * row_bytes, src + idx[k] * row_bytes, row_bytes);
-    }
+  pool->ParallelFor(n_idx, MinRows(row_bytes), [&](int64_t b, int64_t e) {
+    tfs::GatherRowsRange(src, row_bytes, idx, b, e, out);
   });
 }
 
 void tfs_par_scatter_rows(const char* src, int64_t row_bytes,
                           const int64_t* idx, int64_t n_idx, char* out) {
-  const int64_t min_rows = kMinChunkBytes / (row_bytes ? row_bytes : 1) + 1;
   PoolLease pool;
-  pool->ParallelFor(n_idx, min_rows, [&](int64_t b, int64_t e) {
-    for (int64_t k = b; k < e; ++k) {
-      std::memcpy(out + idx[k] * row_bytes, src + k * row_bytes, row_bytes);
-    }
+  pool->ParallelFor(n_idx, MinRows(row_bytes), [&](int64_t b, int64_t e) {
+    tfs::ScatterRowsRange(src, row_bytes, idx, b, e, out);
   });
 }
 
 void tfs_par_pad_ragged(const char* flat, const int64_t* offsets,
                         int64_t n_rows, int64_t max_len, int64_t elem_size,
                         const char* pad_elem, char* out) {
-  const int64_t row_bytes = max_len * elem_size;
-  const int64_t min_rows = kMinChunkBytes / (row_bytes ? row_bytes : 1) + 1;
   PoolLease pool;
-  pool->ParallelFor(n_rows, min_rows, [&](int64_t b, int64_t e) {
-    for (int64_t i = b; i < e; ++i) {
-      const int64_t len = offsets[i + 1] - offsets[i];
-      char* dst = out + i * row_bytes;
-      std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
-      const int64_t pad_count = max_len - len;
-      if (pad_count <= 0) continue;
-      char* pad_dst = dst + len * elem_size;
-      if (pad_elem == nullptr) {
-        std::memset(pad_dst, 0, pad_count * elem_size);
-      } else {
-        for (int64_t j = 0; j < pad_count; ++j) {
-          std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
-        }
-      }
-    }
-  });
+  pool->ParallelFor(
+      n_rows, MinRows(max_len * elem_size), [&](int64_t b, int64_t e) {
+        tfs::PadRaggedRange(
+            flat, offsets, b, e, max_len, elem_size, pad_elem, out);
+      });
 }
 
 void tfs_par_gather_ragged_pad(const char* flat, const int64_t* offsets,
                                const int64_t* idx, int64_t n_idx,
                                int64_t max_len, int64_t elem_size,
                                const char* pad_elem, char* out) {
-  const int64_t row_bytes = max_len * elem_size;
-  const int64_t min_rows = kMinChunkBytes / (row_bytes ? row_bytes : 1) + 1;
   PoolLease pool;
-  pool->ParallelFor(n_idx, min_rows, [&](int64_t b, int64_t e) {
-    for (int64_t k = b; k < e; ++k) {
-      const int64_t i = idx[k];
-      const int64_t len = offsets[i + 1] - offsets[i];
-      char* dst = out + k * row_bytes;
-      std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
-      const int64_t pad_count = max_len - len;
-      if (pad_count <= 0) continue;
-      char* pad_dst = dst + len * elem_size;
-      if (pad_elem == nullptr) {
-        std::memset(pad_dst, 0, pad_count * elem_size);
-      } else {
-        for (int64_t j = 0; j < pad_count; ++j) {
-          std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
-        }
-      }
-    }
-  });
+  pool->ParallelFor(
+      n_idx, MinRows(max_len * elem_size), [&](int64_t b, int64_t e) {
+        tfs::GatherRaggedPadRange(
+            flat, offsets, idx, b, e, max_len, elem_size, pad_elem, out);
+      });
 }
 
 }  // extern "C"

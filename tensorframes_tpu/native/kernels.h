@@ -1,0 +1,77 @@
+// Row-range kernel bodies shared by the serial entry points (packer.cpp)
+// and the thread-pool executor (executor.cpp): exactly one implementation
+// of each loop, parameterized by [row_begin, row_end).
+
+#ifndef TFS_NATIVE_KERNELS_H_
+#define TFS_NATIVE_KERNELS_H_
+
+#include <cstdint>
+#include <cstring>
+
+namespace tfs {
+
+inline void GatherRowsRange(const char* src, int64_t row_bytes,
+                            const int64_t* idx, int64_t begin, int64_t end,
+                            char* out) {
+  for (int64_t k = begin; k < end; ++k) {
+    std::memcpy(out + k * row_bytes, src + idx[k] * row_bytes, row_bytes);
+  }
+}
+
+inline void ScatterRowsRange(const char* src, int64_t row_bytes,
+                             const int64_t* idx, int64_t begin, int64_t end,
+                             char* out) {
+  for (int64_t k = begin; k < end; ++k) {
+    std::memcpy(out + idx[k] * row_bytes, src + k * row_bytes, row_bytes);
+  }
+}
+
+inline void PadRaggedRange(const char* flat, const int64_t* offsets,
+                           int64_t begin, int64_t end, int64_t max_len,
+                           int64_t elem_size, const char* pad_elem,
+                           char* out) {
+  const int64_t row_bytes = max_len * elem_size;
+  for (int64_t i = begin; i < end; ++i) {
+    const int64_t len = offsets[i + 1] - offsets[i];
+    char* dst = out + i * row_bytes;
+    std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
+    const int64_t pad_count = max_len - len;
+    if (pad_count <= 0) continue;
+    char* pad_dst = dst + len * elem_size;
+    if (pad_elem == nullptr) {
+      std::memset(pad_dst, 0, pad_count * elem_size);
+    } else {
+      for (int64_t j = 0; j < pad_count; ++j) {
+        std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
+      }
+    }
+  }
+}
+
+inline void GatherRaggedPadRange(const char* flat, const int64_t* offsets,
+                                 const int64_t* idx, int64_t begin,
+                                 int64_t end, int64_t max_len,
+                                 int64_t elem_size, const char* pad_elem,
+                                 char* out) {
+  const int64_t row_bytes = max_len * elem_size;
+  for (int64_t k = begin; k < end; ++k) {
+    const int64_t i = idx[k];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    char* dst = out + k * row_bytes;
+    std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
+    const int64_t pad_count = max_len - len;
+    if (pad_count <= 0) continue;
+    char* pad_dst = dst + len * elem_size;
+    if (pad_elem == nullptr) {
+      std::memset(pad_dst, 0, pad_count * elem_size);
+    } else {
+      for (int64_t j = 0; j < pad_count; ++j) {
+        std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
+      }
+    }
+  }
+}
+
+}  // namespace tfs
+
+#endif  // TFS_NATIVE_KERNELS_H_

@@ -11,15 +11,18 @@
 //
 // All offsets/lengths are int64 (matches Arrow large-list offsets and numpy
 // int64 index arrays). Buffers are caller-allocated; functions never
-// allocate. Single-threaded by design: callers batch at the column level
-// and the surrounding engine overlaps host packing with device compute.
+// allocate. Loop bodies live in kernels.h, shared with the thread-pool
+// executor (executor.cpp) that runs them split across row ranges for
+// large columns.
 //
-// Build: g++ -O3 -shared -fPIC packer.cpp -o libtfspacker.so  (see
-// tensorframes_tpu/data/packer.py, which builds on demand and falls back to
-// numpy when no toolchain is present).
+// Build: g++ -O3 -shared -fPIC -pthread packer.cpp executor.cpp -o
+// libtfspacker.so (see tensorframes_tpu/data/packer.py, which builds on
+// demand and falls back to numpy when no toolchain is present).
 
-#include <cstring>
 #include <cstdint>
+#include <cstring>
+
+#include "kernels.h"
 
 extern "C" {
 
@@ -33,26 +36,13 @@ void tfs_pad_ragged(const char* flat,
                     int64_t elem_size,
                     const char* pad_elem,
                     char* out) {
-  const int64_t row_bytes = max_len * elem_size;
-  for (int64_t i = 0; i < n_rows; ++i) {
-    const int64_t len = offsets[i + 1] - offsets[i];
-    char* dst = out + i * row_bytes;
-    std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
-    char* pad_dst = dst + len * elem_size;
-    const int64_t pad_count = max_len - len;
-    if (pad_count <= 0) continue;
-    if (pad_elem == nullptr) {
-      std::memset(pad_dst, 0, pad_count * elem_size);
-    } else {
-      for (int64_t j = 0; j < pad_count; ++j) {
-        std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
-      }
-    }
-  }
+  tfs::PadRaggedRange(flat, offsets, 0, n_rows, max_len, elem_size,
+                      pad_elem, out);
 }
 
 // Inverse of tfs_pad_ragged: copy the first lengths[i] elements of each
-// padded row into a flat output buffer.
+// padded row into a flat output buffer. (Output offsets depend on a
+// running prefix sum, so this one stays sequential.)
 void tfs_unpad_ragged(const char* padded,
                       const int64_t* lengths,  // n_rows entries
                       int64_t n_rows,
@@ -76,9 +66,7 @@ void tfs_gather_rows(const char* src,
                      const int64_t* idx,
                      int64_t n_idx,
                      char* out) {
-  for (int64_t k = 0; k < n_idx; ++k) {
-    std::memcpy(out + k * row_bytes, src + idx[k] * row_bytes, row_bytes);
-  }
+  tfs::GatherRowsRange(src, row_bytes, idx, 0, n_idx, out);
 }
 
 // Gather ragged rows by index into a dense padded matrix: the bucketing
@@ -91,36 +79,20 @@ void tfs_gather_ragged_pad(const char* flat,
                            int64_t elem_size,
                            const char* pad_elem,
                            char* out) {
-  const int64_t row_bytes = max_len * elem_size;
-  for (int64_t k = 0; k < n_idx; ++k) {
-    const int64_t i = idx[k];
-    const int64_t len = offsets[i + 1] - offsets[i];
-    char* dst = out + k * row_bytes;
-    std::memcpy(dst, flat + offsets[i] * elem_size, len * elem_size);
-    const int64_t pad_count = max_len - len;
-    if (pad_count <= 0) continue;
-    char* pad_dst = dst + len * elem_size;
-    if (pad_elem == nullptr) {
-      std::memset(pad_dst, 0, pad_count * elem_size);
-    } else {
-      for (int64_t j = 0; j < pad_count; ++j) {
-        std::memcpy(pad_dst + j * elem_size, pad_elem, elem_size);
-      }
-    }
-  }
+  tfs::GatherRaggedPadRange(flat, offsets, idx, 0, n_idx, max_len,
+                            elem_size, pad_elem, out);
 }
 
 // Scatter fixed-width rows by index: out[idx[k]] = src[k]. Inverse of
 // tfs_gather_rows; used to restore original row order after bucketed
-// execution.
+// execution. Duplicate indices are deterministic last-wins here (the
+// parallel variant requires unique indices — see data/packer.py).
 void tfs_scatter_rows(const char* src,
                       int64_t row_bytes,
                       const int64_t* idx,
                       int64_t n_idx,
                       char* out) {
-  for (int64_t k = 0; k < n_idx; ++k) {
-    std::memcpy(out + idx[k] * row_bytes, src + k * row_bytes, row_bytes);
-  }
+  tfs::ScatterRowsRange(src, row_bytes, idx, 0, n_idx, out);
 }
 
 int64_t tfs_packer_abi_version() { return 2; }

@@ -7,7 +7,7 @@ funnel, SURVEY §2.5) with ``shard_map`` programs and XLA collectives.
 from .mesh import make_mesh, default_mesh, data_axis
 from .distributed import map_blocks, map_rows, reduce_blocks, reduce_rows, aggregate
 from .training import ShardedSGDTrainer
-from .moe import init_moe, moe_apply, moe_ffn
+from .moe import init_moe, moe_apply, moe_dispatch_apply, moe_ffn
 from .pipeline import pipeline_apply, pipeline_reference
 from . import multihost
 
@@ -15,6 +15,7 @@ __all__ = [
     "multihost",
     "init_moe",
     "moe_apply",
+    "moe_dispatch_apply",
     "moe_ffn",
     "pipeline_apply",
     "pipeline_reference",

@@ -22,7 +22,13 @@ from typing import Dict
 
 import numpy as np
 
-__all__ = ["init_moe", "moe_ffn", "moe_ffn_sharded", "moe_apply"]
+__all__ = [
+    "init_moe",
+    "moe_ffn",
+    "moe_ffn_sharded",
+    "moe_apply",
+    "moe_dispatch_apply",
+]
 
 #: canonical expert-parallel axis name
 EXPERT_AXIS = "ep"
@@ -160,3 +166,151 @@ def moe_apply(params: Params, x, mesh=None, axis_name: str = EXPERT_AXIS):
             f"size {n}"
         )
     return _moe_program(mesh, axis_name)(params, x)
+
+
+# ---------------------------------------------------------------------------
+# all-to-all (capacity-based) dispatch — the Switch-Transformer data path
+# ---------------------------------------------------------------------------
+
+
+def _dispatch_body(params, x, capacity, axis_name):
+    """Per-shard body: ``x`` [T_local, D] tokens sharded over ``axis_name``;
+    params hold the local expert slab. Tokens are ROUTED: each chip packs
+    up to ``capacity`` tokens per destination chip into a [n, C, D] buffer,
+    one ``all_to_all`` exchanges them, local experts run on what arrived,
+    and a second ``all_to_all`` returns results to the owning chips.
+    Overflow beyond capacity is dropped (contributes zero) — the standard
+    Switch trade; communication is O(n*C*D) instead of replicating T."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.lax.axis_size(axis_name)
+    t_local, d = x.shape
+    n_local = params["w_up"].shape[0]
+
+    logits = x @ jnp.asarray(params["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)          # global expert id [T]
+    gate = jnp.max(probs, axis=-1)               # [T]
+    dst = expert // n_local                      # destination chip [T]
+    local_e = expert % n_local                   # expert id on that chip
+
+    # position of each token within its destination's send buffer: running
+    # count of earlier tokens with the same destination (stable priority by
+    # position, the Switch convention); >= capacity drops
+    onehot = jax.nn.one_hot(dst, n, dtype=jnp.int32)        # [T, n]
+    pos = (jnp.cumsum(onehot, axis=0) - onehot)[jnp.arange(t_local), dst]
+    keep = pos < capacity
+
+    # scatter tokens into the [n, C, D] send buffer; dropped tokens target
+    # the out-of-bounds slot `capacity` so mode="drop" discards them (a
+    # clipped in-bounds index would clobber a kept token's slot)
+    safe_pos = jnp.where(keep, pos, capacity)
+    send = jnp.zeros((n, capacity, d), x.dtype)
+    send = send.at[dst, safe_pos].set(x, mode="drop")
+    send_e = jnp.zeros((n, capacity), jnp.int32)
+    send_e = send_e.at[dst, safe_pos].set(local_e, mode="drop")
+    send_valid = jnp.zeros((n, capacity), jnp.bool_)
+    send_valid = send_valid.at[dst, safe_pos].set(keep, mode="drop")
+
+    # exchange: recv[s] = what chip s sent to me
+    recv = jax.lax.all_to_all(send, axis_name, 0, 0, tiled=False)
+    recv_e = jax.lax.all_to_all(send_e, axis_name, 0, 0, tiled=False)
+    recv_valid = jax.lax.all_to_all(send_valid, axis_name, 0, 0, tiled=False)
+
+    toks = recv.reshape(n * capacity, d)
+    te = recv_e.reshape(n * capacity)
+    tv = recv_valid.reshape(n * capacity)
+
+    # local experts over the received tokens (masked accumulate, same
+    # pattern as the replicated path but over n*C tokens, not T)
+    w_up = jnp.asarray(params["w_up"])
+    b_up = jnp.asarray(params["b_up"])
+    w_down = jnp.asarray(params["w_down"])
+    b_down = jnp.asarray(params["b_down"])
+
+    def one_expert(e, acc):
+        h = jax.nn.gelu(toks @ w_up[e] + b_up[e])
+        y = h @ w_down[e] + b_down[e]
+        m = ((te == e) & tv).astype(toks.dtype)[:, None]
+        return acc + y * m
+
+    out_toks = jax.lax.fori_loop(
+        0, n_local, one_expert, jnp.zeros_like(toks)
+    )
+
+    # return trip: results back to the owning chips, then gather each
+    # token's result from its (dst, pos) slot
+    back = jax.lax.all_to_all(
+        out_toks.reshape(n, capacity, d), axis_name, 0, 0, tiled=False
+    )
+    result = back[dst, jnp.where(keep, pos, 0)]
+    return jnp.where(keep[:, None], result * gate[:, None], 0.0)
+
+
+@functools.lru_cache(maxsize=32)
+def _dispatch_program(mesh, capacity: int, axis_name: str):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    expert_sharded = {
+        "router": P(),
+        "w_up": P(axis_name),
+        "b_up": P(axis_name),
+        "w_down": P(axis_name),
+        "b_down": P(axis_name),
+    }
+    return jax.jit(
+        jax.shard_map(
+            functools.partial(
+                _dispatch_body, capacity=capacity, axis_name=axis_name
+            ),
+            mesh=mesh,
+            in_specs=(expert_sharded, P(axis_name)),
+            out_specs=P(axis_name),
+            check_vma=False,
+        )
+    )
+
+
+def moe_dispatch_apply(
+    params: Params,
+    x,
+    mesh=None,
+    axis_name: str = EXPERT_AXIS,
+    capacity_factor: float = 1.25,
+):
+    """All-to-all routed MoE over ``[B, L, D]`` (Switch-Transformer data
+    path): tokens sharded over ``axis_name``, routed to their expert's chip
+    with ``capacity = ceil(cf * T_local / n)`` slots per (src, dst) pair,
+    processed, and returned. Tokens beyond a destination's capacity are
+    DROPPED (output zero) — choose ``capacity_factor`` >= n for exactness
+    under any routing, or keep the default and accept the standard Switch
+    behavior. Use :func:`moe_apply` for the exact masked-compute variant.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is None:
+        from .mesh import make_mesh
+
+        mesh = make_mesh({axis_name: len(jax.devices())})
+    n = mesh.shape[axis_name]
+    n_experts = params["w_up"].shape[0]
+    if n_experts % n:
+        raise ValueError(
+            f"n_experts={n_experts} must divide by the {axis_name!r} axis "
+            f"size {n}"
+        )
+    b, l, d = x.shape
+    t = b * l
+    if t % n:
+        raise ValueError(
+            f"token count {t} (= {b}x{l}) must divide by the {axis_name!r} "
+            f"axis size {n}"
+        )
+    t_local = t // n
+    capacity = int(np.ceil(capacity_factor * t_local / n))
+    flat = jnp.reshape(jnp.asarray(x), (t, d))
+    out = _dispatch_program(mesh, capacity, axis_name)(params, flat)
+    return jnp.reshape(out, (b, l, d))
