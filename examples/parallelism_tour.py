@@ -1,0 +1,120 @@
+"""Tour of the five mesh axes: dp / tp / sp / ep / pp on one machine.
+
+Every strategy runs against its oracle. Works anywhere: if fewer than 8
+devices are attached, the script provisions 8 virtual CPU devices (the
+same mechanism the test suite and the driver's multichip dryrun use), so
+the sharding semantics are identical to a real 8-chip slice.
+
+Run: ``python examples/parallelism_tour.py``
+"""
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    # size the CPU backend at 8 virtual devices BEFORE backends initialize
+    # (harmless when 8 real chips exist — it only affects the CPU platform);
+    # the same mechanism __graft_entry__.dryrun_multichip uses
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        pass
+    devs = jax.devices()
+    if len(devs) < 8:
+        devs = jax.devices("cpu")
+    if len(devs) < 8:
+        print(f"needs 8 devices, found {len(devs)}")
+        return
+    devs = devs[:8]
+    # pin single-device oracles to the same backend as the meshes —
+    # otherwise a machine whose default device is a TPU computes oracles
+    # in bf16 MXU precision while the mesh runs f32 on CPU, and the
+    # "error" printed is just the precision gap
+    ctx = jax.default_device(devs[0])
+    ctx.__enter__()
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu import parallel as par
+    from tensorframes_tpu.models import TransformerLM
+    from tensorframes_tpu.ops import (
+        attention_reference,
+        ring_attention,
+        ulysses_attention,
+    )
+
+    rng = np.random.default_rng(0)
+
+    # dp: rows sharded over chips — distributed dataframe ops
+    df = tft.TensorFrame.from_columns(
+        {"x": rng.normal(size=100_000).astype(np.float32)}, num_partitions=8
+    )
+    mesh_dp = par.make_mesh({"dp": 8}, devices=devs)
+    total = par.reduce_blocks(
+        lambda x_input: {"x": x_input.sum()}, df, mesh=mesh_dp
+    )
+    print(f"dp  reduce over 8 shards: {float(total):.2f}")
+
+    # dp x tp: sharded SGD (batch over dp, Megatron weights over tp)
+    trainer = par.ShardedSGDTrainer(
+        [16, 32, 4], mesh=par.make_mesh({"dp": 4, "tp": 2}, devices=devs)
+    )
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    y = rng.integers(0, 4, size=64).astype(np.int32)
+    _, losses = trainer.fit(x, y, steps=5)
+    print(f"tp  dp4xtp2 SGD: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+    # sp: ring and ulysses sequence parallelism vs the dense oracle
+    mesh_sp = par.make_mesh({"sp": 8}, devices=devs)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, 8, 64, 16)).astype(np.float32))
+        for _ in range(3)
+    )
+    ref = attention_reference(q, k, v, causal=True)
+    for name, fn in (("ring", ring_attention), ("ulysses", ulysses_attention)):
+        out = fn(q, k, v, mesh=mesh_sp, causal=True)
+        err = float(jnp.max(jnp.abs(out - ref)))
+        print(f"sp  {name} attention over 8 chips: max err {err:.1e}")
+
+    # ep: expert-parallel MoE, masked and all-to-all-routed
+    mesh_ep = par.make_mesh({"ep": 8}, devices=devs)
+    p = par.init_moe(0, d_model=16, d_ff=32, n_experts=16)
+    toks = jnp.asarray(rng.normal(size=(4, 16, 16)).astype(np.float32))
+    dense = par.moe_ffn(p, toks)
+    masked = par.moe_apply(p, toks, mesh=mesh_ep)
+    routed = par.moe_dispatch_apply(p, toks, mesh=mesh_ep, capacity_factor=8.0)
+    print(
+        f"ep  MoE 16 experts over 8 chips: masked err "
+        f"{float(jnp.max(jnp.abs(masked - dense))):.1e}, routed err "
+        f"{float(jnp.max(jnp.abs(routed - dense))):.1e}"
+    )
+
+    # pp: GPipe pipeline, one stage per chip
+    mesh_pp = par.make_mesh({"pp": 8}, devices=devs)
+    stages = {
+        "w": rng.normal(0, 0.3, (8, 12, 12)).astype(np.float32),
+        "b": rng.normal(0, 0.1, (8, 12)).astype(np.float32),
+    }
+
+    def stage_fn(sp, h):
+        return jnp.tanh(h @ sp["w"] + sp["b"])
+
+    xb = rng.normal(size=(16, 12)).astype(np.float32)
+    got = par.pipeline_apply(stage_fn, stages, xb, n_micro=4, mesh=mesh_pp)
+    want = par.pipeline_reference(stage_fn, stages, jnp.asarray(xb))
+    print(
+        f"pp  8-stage pipeline, 4 microbatches: max err "
+        f"{float(jnp.max(jnp.abs(got - want))):.1e}"
+    )
+
+    # dp x sp composed in ONE train step (batch-sharded ring attention)
+    lm = TransformerLM.init(0, vocab=32, d_model=16, n_heads=4, max_len=17)
+    toks2 = rng.integers(0, 32, size=(8, 17)).astype(np.int32)
+    l2 = lm.fit_sharded(toks2, par.make_mesh({"dp": 2, "sp": 4}, devices=devs), steps=4)
+    print(f"dpxsp transformer step: loss {l2[0]:.3f} -> {l2[-1]:.3f}")
+
+
+if __name__ == "__main__":
+    main()

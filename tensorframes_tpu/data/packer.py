@@ -41,6 +41,7 @@ logger = get_logger("data.packer")
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "packer.cpp")
 _SRC_EXEC = os.path.join(_NATIVE_DIR, "executor.cpp")
+_SRC_HDR = os.path.join(_NATIVE_DIR, "kernels.h")
 _LIB = os.path.join(_NATIVE_DIR, "libtfspacker.so")
 
 _lock = threading.Lock()
@@ -72,7 +73,9 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         _tried = True
         if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < max(
-            os.path.getmtime(_SRC), os.path.getmtime(_SRC_EXEC)
+            os.path.getmtime(_SRC),
+            os.path.getmtime(_SRC_EXEC),
+            os.path.getmtime(_SRC_HDR),  # kernel bodies live here
         ):
             if not _build():
                 return None
@@ -282,7 +285,9 @@ def scatter_rows(src: np.ndarray, idx: np.ndarray, n_rows: int) -> np.ndarray:
         fn = lib.tfs_scatter_rows
         if out.nbytes >= _PAR_THRESHOLD_BYTES and (
             len(idx) == 0
-            or int(np.bincount(idx, minlength=n_rows).max()) <= 1
+            # no minlength: padding zeros cannot change the max, and
+            # the temp stays bounded by max(idx)+1, not table size
+            or int(np.bincount(idx).max()) <= 1
         ):
             fn = lib.tfs_par_scatter_rows
         fn(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))

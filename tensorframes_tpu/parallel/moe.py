@@ -208,19 +208,17 @@ def _dispatch_body(params, x, capacity, axis_name):
     safe_pos = jnp.where(keep, pos, capacity)
     send = jnp.zeros((n, capacity, d), x.dtype)
     send = send.at[dst, safe_pos].set(x, mode="drop")
-    send_e = jnp.zeros((n, capacity), jnp.int32)
+    # empty slots carry expert id -1, which matches no local expert — no
+    # separate validity buffer (and no third all_to_all) needed
+    send_e = jnp.full((n, capacity), -1, jnp.int32)
     send_e = send_e.at[dst, safe_pos].set(local_e, mode="drop")
-    send_valid = jnp.zeros((n, capacity), jnp.bool_)
-    send_valid = send_valid.at[dst, safe_pos].set(keep, mode="drop")
 
     # exchange: recv[s] = what chip s sent to me
     recv = jax.lax.all_to_all(send, axis_name, 0, 0, tiled=False)
     recv_e = jax.lax.all_to_all(send_e, axis_name, 0, 0, tiled=False)
-    recv_valid = jax.lax.all_to_all(send_valid, axis_name, 0, 0, tiled=False)
 
     toks = recv.reshape(n * capacity, d)
     te = recv_e.reshape(n * capacity)
-    tv = recv_valid.reshape(n * capacity)
 
     # local experts over the received tokens (masked accumulate, same
     # pattern as the replicated path but over n*C tokens, not T)
@@ -232,7 +230,7 @@ def _dispatch_body(params, x, capacity, axis_name):
     def one_expert(e, acc):
         h = jax.nn.gelu(toks @ w_up[e] + b_up[e])
         y = h @ w_down[e] + b_down[e]
-        m = ((te == e) & tv).astype(toks.dtype)[:, None]
+        m = (te == e).astype(toks.dtype)[:, None]
         return acc + y * m
 
     out_toks = jax.lax.fori_loop(

@@ -10,7 +10,16 @@ import pytest
 
 import tensorframes_tpu as tft
 
-DTYPES = [np.float64, np.float32, np.int32, np.int64]
+# the reference's four types, plus the TPU-first extras the registry
+# advertises (bfloat16 is the MXU-native dtype; test values stay small so
+# every sum is exactly representable at any precision)
+DTYPES = [np.float64, np.float32, np.int32, np.int64, np.float16]
+try:
+    import ml_dtypes
+
+    DTYPES.append(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    pass
 
 
 def ids(dt):
