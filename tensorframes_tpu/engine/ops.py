@@ -115,7 +115,16 @@ def _as_graph(
             f"{type(fetches).__name__}"
         )
     if feed_dict:
-        g = g.with_inputs(feed_dict)
+        # memoize the renamed wrapper on the underlying graph: a fresh
+        # CapturedGraph per call would drop every jitted-program cache
+        # attached to it and recompile on each invocation
+        fd_key = tuple(sorted(feed_dict.items()))
+        cache = getattr(g, "_with_inputs_cache", None)
+        if cache is None:
+            cache = g._with_inputs_cache = {}
+        if fd_key not in cache:
+            cache[fd_key] = g.with_inputs(feed_dict)
+        g = cache[fd_key]
     return g
 
 

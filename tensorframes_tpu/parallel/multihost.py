@@ -30,6 +30,8 @@ __all__ = [
     "global_batch",
     "local_rows",
     "sync_global",
+    "map_blocks",
+    "reduce_blocks",
 ]
 
 
@@ -132,3 +134,131 @@ def sync_global(x):
 
         return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
     return np.asarray(arr)
+
+
+# ---------------------------------------------------------------------------
+# dataframe ops over a multi-process mesh: each host feeds its local rows
+# ---------------------------------------------------------------------------
+
+
+def _global_block_feed(local_df, binding, mesh):
+    """Assemble the globally-sharded feed from this process's local frame:
+    every process contributes its rows via ``global_batch`` — the analog of
+    the reference's per-executor partitions, except no driver ever sees the
+    whole table."""
+    feed = {}
+    for ph, col in binding.items():
+        feed[ph] = global_batch(local_df.column_block(col), mesh)
+    return feed
+
+
+def map_blocks(fetches, local_df, mesh, feed_dict=None):
+    """Multi-host ``map_blocks``: ``local_df`` holds THIS process's rows;
+    all processes call with the same program and their own shard. Returns
+    a local frame of this process's result rows (fetch columns + inputs).
+    Eager (the cross-process collective assembly happens now), unlike the
+    single-process lazy engine — multi-host programs are SPMD, so laziness
+    would only defer a rendezvous every process must reach anyway."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..engine.ops import _as_graph, _ensure_precision
+    from ..engine.validation import (
+        InvalidDimensionError,
+        check_output_collisions,
+        validate_map_inputs,
+    )
+    from ..frame import TensorFrame
+    from ..schema import Unknown
+    from .distributed import _cached_program
+    from .mesh import DATA_AXIS
+
+    g = _as_graph(fetches, local_df, cell_inputs=False, feed_dict=feed_dict)
+    binding = validate_map_inputs(g, local_df.schema, block=True)
+    _ensure_precision(g, local_df.schema)
+    # same pre-flight contract as the single-process engine: no scalar
+    # outputs, no collisions with existing columns
+    out_specs = g.analyze(
+        {
+            ph: local_df.schema[col].block_shape.with_lead(Unknown)
+            for ph, col in binding.items()
+        }
+    )
+    for name, spec in out_specs.items():
+        if spec.shape.num_dims == 0:
+            raise InvalidDimensionError(
+                f"map_blocks output {name!r} is a scalar; map outputs must "
+                f"keep the leading row dimension (use reduce_blocks)"
+            )
+    check_output_collisions(out_specs, local_df.schema)
+    feed = _global_block_feed(local_df, binding, mesh)
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    prog = _cached_program(
+        g,
+        (mesh, "mh_map"),
+        lambda: jax.jit(
+            g.fn, out_shardings={f: sharding for f in g.fetch_names}
+        ),
+    )
+    res = prog(feed)
+    cols = {}
+    for name in g.fetch_names:
+        cols[name] = _local_rows_of(res[name])
+    out = dict(cols)
+    for c in local_df.schema:
+        out[c.name] = local_df.column_data(c.name).host()
+    return TensorFrame.from_columns(out)
+
+
+def _local_rows_of(arr) -> np.ndarray:
+    """This process's rows of a dp-sharded global array, in row order,
+    deduplicated: on a multi-axis mesh the row shard is replicated over the
+    other axes and ``addressable_shards`` yields every replica."""
+    seen = set()
+    parts = []
+    for s in sorted(
+        arr.addressable_shards, key=lambda s: s.index[0].start or 0
+    ):
+        key = (s.index[0].start, s.index[0].stop)
+        if key in seen:
+            continue
+        seen.add(key)
+        parts.append(np.asarray(s.data))
+    return np.concatenate(parts)
+
+
+def reduce_blocks(fetches, local_df, mesh):
+    """Multi-host ``reduce_blocks``: block-reduce over the GLOBAL rows with
+    each process feeding its shard; the result is replicated, so every
+    process returns the same numpy value(s) — no driver funnel."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..engine.ops import (
+        _as_graph,
+        _ensure_precision,
+        _unpack_reduce_result,
+    )
+    from ..engine.validation import validate_reduce_block_graph
+    from .mesh import DATA_AXIS
+
+    g = _as_graph(fetches, local_df, cell_inputs=False)
+    binding = validate_reduce_block_graph(g, local_df.schema)
+    _ensure_precision(g, local_df.schema)
+    feed = {
+        f"{f}_input": global_batch(local_df.column_block(col), mesh)
+        for f, col in binding.items()
+    }
+    from .distributed import _cached_program
+
+    rep = NamedSharding(mesh, P())
+    prog = _cached_program(
+        g,
+        (mesh, "mh_reduce"),
+        lambda: jax.jit(
+            g.fn, out_shardings={f: rep for f in g.fetch_names}
+        ),
+    )
+    res = prog(feed)
+    host = {f: sync_global(res[f]) for f in g.fetch_names}
+    return _unpack_reduce_result(host, g.fetch_names)

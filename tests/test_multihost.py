@@ -55,10 +55,27 @@ try:
 except ValueError:
     uneven_rejected = True
 
+# dataframe ops over the multi-process mesh: each process holds only its
+# local rows; no process ever sees the whole table
+import tensorframes_tpu as tft
+
+data = np.arange(48, dtype=np.float32)  # the conceptual global column
+rows = multihost.local_rows(48)
+local_df = tft.TensorFrame.from_columns({"x": data[rows]})
+dp_mesh = make_mesh({"dp": 8})
+mapped = multihost.map_blocks(
+    lambda x: {"z": x * 2.0 + 1.0}, local_df, dp_mesh
+)
+local_z = [float(r.z) for r in mapped.collect()]
+reduced = multihost.reduce_blocks(
+    lambda x_input: {"x": x_input.sum()}, local_df, dp_mesh
+)
+
 if pid == 0:
     print("RESULT " + json.dumps(
         {"losses": losses, "psum": float(total),
-         "uneven_rejected": uneven_rejected}
+         "uneven_rejected": uneven_rejected,
+         "local_z": local_z, "global_sum": float(reduced)}
     ), flush=True)
 """
 
@@ -134,3 +151,55 @@ class TestLocalRowsHelper:
     def test_uneven_split_rejected_two_process(self, two_process_result):
         # exercised inside the 2-process worker, where 33 % 2 != 0
         assert two_process_result["uneven_rejected"] is True
+
+    def test_dataframe_ops_over_processes(self, two_process_result):
+        # process 0 held rows 0..23 of arange(48); its map result must be
+        # exactly its local slice transformed, and the reduce must see the
+        # GLOBAL table (both processes' rows)
+        data = np.arange(48, dtype=np.float32)
+        np.testing.assert_allclose(
+            two_process_result["local_z"], (data[:24] * 2.0 + 1.0).tolist()
+        )
+        assert two_process_result["global_sum"] == float(data.sum())
+
+
+class TestMultihostOpValidation:
+    """Single-process checks of the multihost op pre-flight contract (the
+    collective paths themselves run in the two-process fixture)."""
+
+    def test_output_collision_rejected(self):
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.engine.validation import OutputCollisionError
+        from tensorframes_tpu.parallel import make_mesh, multihost
+
+        df = tft.TensorFrame.from_columns({"x": np.arange(8.0)})
+        with pytest.raises(OutputCollisionError):
+            multihost.map_blocks(
+                lambda x: {"x": x * 2.0}, df, make_mesh({"dp": 8})
+            )
+
+    def test_scalar_output_rejected(self):
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.engine.validation import InvalidDimensionError
+        from tensorframes_tpu.parallel import make_mesh, multihost
+
+        df = tft.TensorFrame.from_columns({"x": np.arange(8.0)})
+        with pytest.raises(InvalidDimensionError, match="reduce_blocks"):
+            multihost.map_blocks(
+                lambda x: {"z": x.sum()}, df, make_mesh({"dp": 8})
+            )
+
+    def test_multi_axis_mesh_dedups_replica_shards(self):
+        # P("dp") output on a dp x tp mesh is replicated over tp;
+        # the local-row extraction must not duplicate rows
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.parallel import make_mesh, multihost
+
+        df = tft.TensorFrame.from_columns({"x": np.arange(8.0)})
+        out = multihost.map_blocks(
+            lambda x: {"z": x + 1.0}, df, make_mesh({"dp": 4, "tp": 2})
+        )
+        assert out.num_rows == 8
+        np.testing.assert_allclose(
+            [r.z for r in out.collect()], np.arange(8.0) + 1.0
+        )
