@@ -38,8 +38,12 @@ def init_transformer(
     n_layers: int = 2,
     max_len: int = 128,
     d_ff: Optional[int] = None,
+    moe_experts: Optional[int] = None,
     dtype=np.float32,
 ) -> Params:
+    """``moe_experts``: replace every block's dense MLP with a top-1
+    routed mixture of that many experts (:mod:`..parallel.moe`); the
+    expert slabs shard over an ``ep`` mesh axis at apply time."""
     if d_model % n_heads:
         raise ValueError(f"d_model {d_model} must divide by n_heads {n_heads}")
     d_ff = d_ff or 4 * d_model
@@ -55,17 +59,26 @@ def init_transformer(
         "ln_f": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
         "n_heads": n_heads,
     }
-    for _ in range(n_layers):
-        params["blocks"].append(
-            {
-                "ln1": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
-                "qkv": dense(d_model, 3 * d_model),
-                "proj": dense(d_model, d_model),
-                "ln2": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
-                "up": dense(d_model, d_ff),
-                "down": dense(d_ff, d_model),
-            }
-        )
+    for li in range(n_layers):
+        block = {
+            "ln1": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
+            "qkv": dense(d_model, 3 * d_model),
+            "proj": dense(d_model, d_model),
+            "ln2": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
+        }
+        if moe_experts is None:
+            block["up"] = dense(d_model, d_ff)
+            block["down"] = dense(d_ff, d_model)
+        else:
+            from ..parallel.moe import init_moe
+
+            # derive expert seeds from the model rng so they never collide
+            # with the main seed (seed*k+li would reuse generator streams)
+            block["moe"] = init_moe(
+                int(rng.integers(0, 2**31)), d_model, d_ff, moe_experts,
+                dtype=dtype,
+            )
+        params["blocks"].append(block)
     return params
 
 
@@ -139,13 +152,22 @@ def transformer_logits(
     embed = jnp.asarray(params["embed"])
     pos = jnp.asarray(params["pos"])
     x = embed[tokens] + pos[:length][None]
+    from ..parallel.moe import moe_apply, moe_ffn
+
     for block in params["blocks"]:
         h = _ln(x, block["ln1"])
         x = x + _attention(
             h, block, n_heads, causal, attn_impl, mesh, batch_axis
         )
         h = _ln(x, block["ln2"])
-        x = x + jax.nn.gelu(h @ block["up"]) @ block["down"]
+        if "moe" in block:
+            x = x + (
+                moe_apply(block["moe"], h, mesh=mesh)
+                if mesh is not None and "ep" in mesh.axis_names
+                else moe_ffn(block["moe"], h)
+            )
+        else:
+            x = x + jax.nn.gelu(h @ block["up"]) @ block["down"]
     x = _ln(x, params["ln_f"])
     return x @ embed.T
 
