@@ -130,6 +130,7 @@ def transformer_logits(
     attn_impl: str = "reference",
     mesh=None,
     batch_axis=None,
+    collect_moe_aux: bool = False,
 ):
     """``tokens`` [B, L] int32 -> logits [B, L, vocab].
 
@@ -152,8 +153,14 @@ def transformer_logits(
     embed = jnp.asarray(params["embed"])
     pos = jnp.asarray(params["pos"])
     x = embed[tokens] + pos[:length][None]
-    from ..parallel.moe import moe_apply, moe_ffn
+    from ..parallel.moe import (
+        EXPERT_AXIS,
+        moe_apply,
+        moe_ffn,
+        moe_load_balance_loss,
+    )
 
+    moe_aux = 0.0
     for block in params["blocks"]:
         h = _ln(x, block["ln1"])
         x = x + _attention(
@@ -163,13 +170,18 @@ def transformer_logits(
         if "moe" in block:
             x = x + (
                 moe_apply(block["moe"], h, mesh=mesh)
-                if mesh is not None and "ep" in mesh.axis_names
+                if mesh is not None and EXPERT_AXIS in mesh.axis_names
                 else moe_ffn(block["moe"], h)
             )
+            if collect_moe_aux:
+                moe_aux = moe_aux + moe_load_balance_loss(block["moe"], h)
         else:
             x = x + jax.nn.gelu(h @ block["up"]) @ block["down"]
     x = _ln(x, params["ln_f"])
-    return x @ embed.T
+    logits = x @ embed.T
+    if collect_moe_aux:
+        return logits, moe_aux
+    return logits
 
 
 def token_nll(
@@ -195,12 +207,23 @@ def token_nll(
 
 def transformer_loss(
     params: Params, tokens, attn_impl: str = "reference", mesh=None,
-    batch_axis=None,
+    batch_axis=None, moe_aux_weight: float = 0.0,
 ):
-    """Next-token cross entropy (mean over all predicted positions)."""
-    return token_nll(
+    """Next-token cross entropy (mean over all predicted positions).
+
+    ``moe_aux_weight`` > 0 adds the Switch load-balancing loss summed over
+    the MoE blocks (typical value 1e-2) — the in-tree remedy for router
+    collapse when training with ``moe_experts``."""
+    ce = token_nll(
         params, tokens, attn_impl=attn_impl, mesh=mesh, batch_axis=batch_axis
     ).mean()
+    if moe_aux_weight:
+        _, aux = transformer_logits(
+            params, tokens[:, :-1], causal=True, attn_impl=attn_impl,
+            mesh=mesh, batch_axis=batch_axis, collect_moe_aux=True,
+        )
+        ce = ce + moe_aux_weight * aux
+    return ce
 
 
 class TransformerLM:

@@ -28,6 +28,7 @@ __all__ = [
     "moe_ffn_sharded",
     "moe_apply",
     "moe_dispatch_apply",
+    "moe_load_balance_loss",
 ]
 
 #: canonical expert-parallel axis name
@@ -312,3 +313,25 @@ def moe_dispatch_apply(
     flat = jnp.reshape(jnp.asarray(x), (t, d))
     out = _dispatch_program(mesh, capacity, axis_name)(params, flat)
     return jnp.reshape(out, (b, l, d))
+
+
+def moe_load_balance_loss(params: Params, x):
+    """Switch-Transformer auxiliary load-balancing loss:
+    ``E * sum_e f_e * p_e`` where ``f_e`` is the fraction of tokens routed
+    to expert ``e`` (top-1) and ``p_e`` the mean router probability. Equals
+    1.0 under perfectly uniform routing; add a small multiple to the task
+    loss to keep experts utilized (dropped-token rates down under the
+    capacity dispatch). Differentiable through ``p_e`` (the ``f_e`` factor
+    carries no gradient, per the standard formulation)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_experts = params["w_up"].shape[0]
+    logits = x @ jnp.asarray(params["router"])
+    probs = jax.nn.softmax(logits, axis=-1).reshape(-1, n_experts)
+    chosen = jnp.argmax(probs, axis=-1)
+    f = jnp.mean(
+        jax.nn.one_hot(chosen, n_experts, dtype=probs.dtype), axis=0
+    )
+    p = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(jax.lax.stop_gradient(f) * p)

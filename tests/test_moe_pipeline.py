@@ -186,3 +186,42 @@ class TestMoEDispatch:
         x = jnp.zeros((1, 6, 8), jnp.float32)  # 6 tokens on a 4-way axis
         with pytest.raises(ValueError, match="token count"):
             moe_dispatch_apply(params, x, mesh=mesh)
+
+
+class TestLoadBalanceLoss:
+    def test_uniform_routing_is_one(self, nprng):
+        from tensorframes_tpu.parallel import init_moe, moe_load_balance_loss
+
+        # router forced to route token i to expert i % E exactly
+        params = init_moe(0, d_model=4, d_ff=8, n_experts=4)
+        params = dict(params)
+        x = np.eye(4, dtype=np.float32)[None].repeat(8, axis=0)  # [8,4,4]
+        params["router"] = np.eye(4, dtype=np.float32) * 10.0
+        loss = float(moe_load_balance_loss(params, jnp.asarray(x)))
+        assert abs(loss - 1.0) < 0.35  # near-uniform -> near 1
+
+    def test_collapsed_routing_is_large(self, nprng):
+        from tensorframes_tpu.parallel import init_moe, moe_load_balance_loss
+
+        params = init_moe(1, d_model=4, d_ff=8, n_experts=4)
+        params = dict(params)
+        params["router"] = np.zeros((4, 4), np.float32)
+        params["router"][:, 0] = 10.0
+        x = jnp.asarray(
+            np.abs(nprng.normal(size=(2, 16, 4))).astype(np.float32)
+        )
+        loss = float(moe_load_balance_loss(params, x))
+        assert loss > 2.0  # all mass on one expert -> ~E
+
+    def test_differentiable(self, nprng):
+        import jax
+        from tensorframes_tpu.parallel import init_moe, moe_load_balance_loss
+
+        params = init_moe(2, d_model=4, d_ff=8, n_experts=4)
+        x = jnp.asarray(nprng.normal(size=(1, 8, 4)).astype(np.float32))
+
+        g = jax.grad(
+            lambda r: moe_load_balance_loss({**params, "router": r}, x)
+        )(jnp.asarray(params["router"]))
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0

@@ -138,3 +138,27 @@ class TestMoETransformer:
         np.testing.assert_allclose(
             np.asarray(sharded), np.asarray(local), rtol=2e-4, atol=2e-4
         )
+
+    def test_moe_aux_loss_wired_into_training(self):
+        rng = np.random.default_rng(6)
+        lm = TransformerLM.init(
+            0, vocab=16, d_model=16, n_heads=4, max_len=16, moe_experts=4
+        )
+        toks = rng.integers(0, 16, size=(4, 16)).astype(np.int32)
+        losses = lm._sgd_loop(
+            toks, steps=4, lr=0.2, loss_kwargs=dict(moe_aux_weight=1e-2)
+        )
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_aux_collection_returns_pair(self):
+        from tensorframes_tpu.models.transformer import transformer_logits
+
+        lm = TransformerLM.init(
+            0, vocab=16, d_model=16, n_heads=4, max_len=16, moe_experts=4
+        )
+        toks = np.zeros((2, 16), np.int32)
+        logits, aux = transformer_logits(
+            lm.params, toks, collect_moe_aux=True
+        )
+        assert np.asarray(logits).shape == (2, 16, 16)
+        assert float(aux) > 0
