@@ -186,23 +186,27 @@ def transformer_logits(
 
 def token_nll(
     params: Params, tokens, attn_impl: str = "reference", mesh=None,
-    batch_axis=None,
+    batch_axis=None, collect_moe_aux: bool = False,
 ):
     """Per-position next-token negative log-likelihood ``[B, L-1]`` — the
-    one implementation both training loss and frame scoring reduce over."""
+    one implementation both training loss and frame scoring reduce over.
+    With ``collect_moe_aux`` returns ``(nll, aux)`` from the SAME forward
+    (no second pass)."""
     import jax
     import jax.numpy as jnp
 
-    logits = transformer_logits(
+    fwd = transformer_logits(
         params, tokens[:, :-1], causal=True, attn_impl=attn_impl, mesh=mesh,
-        batch_axis=batch_axis,
+        batch_axis=batch_axis, collect_moe_aux=collect_moe_aux,
     )
+    logits, aux = fwd if collect_moe_aux else (fwd, None)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(
         logp, targets[..., None].astype(jnp.int32), axis=-1
     )
-    return -picked[..., 0]
+    nll = -picked[..., 0]
+    return (nll, aux) if collect_moe_aux else nll
 
 
 def transformer_loss(
@@ -214,16 +218,15 @@ def transformer_loss(
     ``moe_aux_weight`` > 0 adds the Switch load-balancing loss summed over
     the MoE blocks (typical value 1e-2) — the in-tree remedy for router
     collapse when training with ``moe_experts``."""
-    ce = token_nll(
+    if moe_aux_weight:
+        nll, aux = token_nll(
+            params, tokens, attn_impl=attn_impl, mesh=mesh,
+            batch_axis=batch_axis, collect_moe_aux=True,
+        )
+        return nll.mean() + moe_aux_weight * aux
+    return token_nll(
         params, tokens, attn_impl=attn_impl, mesh=mesh, batch_axis=batch_axis
     ).mean()
-    if moe_aux_weight:
-        _, aux = transformer_logits(
-            params, tokens[:, :-1], causal=True, attn_impl=attn_impl,
-            mesh=mesh, batch_axis=batch_axis, collect_moe_aux=True,
-        )
-        ce = ce + moe_aux_weight * aux
-    return ce
 
 
 class TransformerLM:

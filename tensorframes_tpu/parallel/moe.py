@@ -322,7 +322,10 @@ def moe_load_balance_loss(params: Params, x):
     1.0 under perfectly uniform routing; add a small multiple to the task
     loss to keep experts utilized (dropped-token rates down under the
     capacity dispatch). Differentiable through ``p_e`` (the ``f_e`` factor
-    carries no gradient, per the standard formulation)."""
+    carries no gradient, per the standard formulation). Recomputes the
+    router projection — one [T, D] x [D, E] matmul, negligible next to the
+    expert FFNs — so it composes with any apply path without changing
+    their signatures."""
     import jax
     import jax.numpy as jnp
 

@@ -38,11 +38,15 @@ def pipeline_reference(stage_fn, stacked_params, x):
     return h
 
 
-def _pipeline_body(stage_fn, n_micro, params_local, x_micro, axis_name):
+def _pipeline_body(
+    stage_fn, n_micro, params_local, x_micro, axis_name, batch_axis=None
+):
     """Per-shard schedule. ``params_local``: this chip's stage params (no
-    stage axis). ``x_micro``: [n_micro, mb, ...] microbatched input,
-    replicated (only stage 0 consumes it). Returns [n_micro, mb, ...]
-    outputs (valid on the LAST stage; psum distributes them)."""
+    stage axis). ``x_micro``: [n_micro, mb, ...] microbatched input —
+    replicated over the pipeline axis (only stage 0 consumes it) and, with
+    ``batch_axis``, row-sharded over that axis. Returns [n_micro, mb, ...]
+    outputs (valid on the LAST stage; the psum over the PIPELINE axis
+    distributes them to every stage; batch shards stay sharded)."""
     import jax
     import jax.numpy as jnp
 
@@ -56,7 +60,12 @@ def _pipeline_body(stage_fn, n_micro, params_local, x_micro, axis_name):
     from ..ops.seq_common import pcast_varying
 
     def vary(t):
-        return pcast_varying(t, axis_name)
+        # carries inherit the microbatch input's variance: pp always, plus
+        # the batch axis when microbatch rows are dp-sharded (pp x dp)
+        t = pcast_varying(t, axis_name)
+        if batch_axis is not None:
+            t = pcast_varying(t, batch_axis)
+        return t
 
     perm = [(i, i + 1) for i in range(n - 1)]  # downstream neighbor
 
@@ -95,7 +104,7 @@ def _pipeline_body(stage_fn, n_micro, params_local, x_micro, axis_name):
 
 
 @functools.lru_cache(maxsize=8)
-def _pipeline_program(stage_fn, n_micro, mesh, axis_name):
+def _pipeline_program(stage_fn, n_micro, mesh, axis_name, batch_axis=None):
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -104,19 +113,22 @@ def _pipeline_program(stage_fn, n_micro, mesh, axis_name):
             lambda a: a[0], stacked_params
         )  # shard_map gives [1, ...] slabs on the stage axis
         return _pipeline_body(
-            stage_fn, n_micro, params_local, x_micro, axis_name
+            stage_fn, n_micro, params_local, x_micro, axis_name, batch_axis
         )
 
+    # microbatch rows ([n_micro, mb, ...] axis 1) shard over batch_axis
+    # when given: pp x dp in one program
+    x_spec = P(None, batch_axis)
     return jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(axis_name), P()),
-            out_specs=P(),
-            # the schedule mixes replicated microbatch input with
+            in_specs=(P(axis_name), x_spec),
+            out_specs=x_spec,
+            # the schedule mixes pp-replicated microbatch input with
             # ppermute-varying activations inside jnp.where; the final
-            # psum re-establishes replication, so the VMA check only
-            # rejects what is correct by construction here
+            # psum re-establishes replication over pp (batch shards stay
+            # sharded over batch_axis), which the VMA check cannot see
             check_vma=False,
         )
     )
@@ -129,6 +141,7 @@ def pipeline_apply(
     n_micro: int,
     mesh=None,
     axis_name: str = PIPE_AXIS,
+    batch_axis=None,
 ):
     """Run ``x`` through ``n_stages`` pipeline stages sharded over the
     mesh's ``axis_name`` axis.
@@ -161,8 +174,25 @@ def pipeline_apply(
             f"batch {b} must divide by n_micro={n_micro}"
         )
     mb = b // n_micro
+    if batch_axis is not None:
+        if batch_axis == axis_name:
+            raise ValueError(
+                f"batch_axis must differ from the pipeline axis "
+                f"{axis_name!r}: sharding rows over the stage axis would "
+                f"feed only one rank's rows through the schedule"
+            )
+        if batch_axis not in mesh.shape:
+            raise ValueError(
+                f"batch_axis {batch_axis!r} is not a mesh axis; mesh has "
+                f"{tuple(mesh.shape)}"
+            )
+        if mb % mesh.shape[batch_axis]:
+            raise ValueError(
+                f"microbatch size {mb} must divide by the {batch_axis!r} "
+                f"axis size {mesh.shape[batch_axis]}"
+            )
     x_micro = jnp.reshape(jnp.asarray(x), (n_micro, mb) + x.shape[1:])
-    out = _pipeline_program(stage_fn, n_micro, mesh, axis_name)(
+    out = _pipeline_program(stage_fn, n_micro, mesh, axis_name, batch_axis)(
         stacked_params, x_micro
     )
     return jnp.reshape(out, x.shape)

@@ -225,3 +225,47 @@ class TestLoadBalanceLoss:
         )(jnp.asarray(params["router"]))
         assert np.isfinite(np.asarray(g)).all()
         assert float(jnp.abs(g).sum()) > 0
+
+
+class TestPipelineDataParallel:
+    """pp x dp in one program: microbatch rows sharded over dp while
+    activations hop stages over pp."""
+
+    def test_matches_sequential(self, nprng):
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        params = _stacked_params(nprng, 4, 8)
+        x = nprng.normal(size=(16, 8)).astype(np.float32)
+        out = pipeline_apply(
+            _stage_fn, params, x, n_micro=4, mesh=mesh, batch_axis="dp"
+        )
+        ref = pipeline_reference(_stage_fn, params, jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_bad_batch_axis_rejected(self, nprng):
+        mesh = make_mesh({"pp": 4})
+        params = _stacked_params(nprng, 4, 8)
+        with pytest.raises(ValueError, match="batch_axis"):
+            pipeline_apply(
+                _stage_fn, params, np.zeros((8, 8), np.float32),
+                n_micro=2, mesh=mesh, batch_axis="dp",
+            )
+
+    def test_indivisible_microbatch_rejected(self, nprng):
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        params = _stacked_params(nprng, 4, 8)
+        with pytest.raises(ValueError, match="microbatch size"):
+            pipeline_apply(
+                _stage_fn, params, np.zeros((6, 8), np.float32),
+                n_micro=2, mesh=mesh, batch_axis="dp",  # mb=3, dp=2
+            )
+
+    def test_batch_axis_equal_pipe_axis_rejected(self, nprng):
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        params = _stacked_params(nprng, 4, 8)
+        with pytest.raises(ValueError, match="must differ"):
+            pipeline_apply(
+                _stage_fn, params, np.zeros((8, 8), np.float32),
+                n_micro=2, mesh=mesh, batch_axis="pp",
+            )
