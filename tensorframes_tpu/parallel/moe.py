@@ -60,8 +60,9 @@ def _expert_partials(params, x, expert_offset, gates, expert_ids):
     """Sum of local experts' outputs over tokens routed to them.
 
     ``x``: [B, L, D]; params hold the LOCAL expert slab (leading axis =
-    local expert count); ``expert_ids``/``gates``: [B, L] global top-1
-    routing. Masked compute: experts not chosen contribute zero."""
+    local expert count); ``expert_ids``/``gates``: [B, L, k] global top-k
+    routing (k=1 for switch-style). Masked compute: an expert's output is
+    scaled by the sum of the gates of whichever top-k slots chose it."""
     import jax
     import jax.numpy as jnp
 
@@ -80,7 +81,8 @@ def _expert_partials(params, x, expert_offset, gates, expert_ids):
         h = jax.nn.gelu(x @ w_up + b_up)
         y = h @ w_down + b_down
         mask = (expert_ids == e_local + expert_offset).astype(x.dtype)
-        return acc + y * (gates * mask)[..., None]
+        combined_gate = (gates * mask).sum(axis=-1)  # over the k slots
+        return acc + y * combined_gate[..., None]
 
     n_local = w_up_all.shape[0]
     acc0 = jnp.zeros_like(x)
@@ -89,34 +91,47 @@ def _expert_partials(params, x, expert_offset, gates, expert_ids):
     )
 
 
-def moe_ffn(params: Params, x):
-    """Dense oracle: top-1 routed MoE FFN, all experts local.
-    ``x``: [B, L, D] -> [B, L, D]."""
+def _route_topk(params, x, k):
+    """Top-k routing: ``(gates [B, L, k], expert_ids [B, L, k])``; for
+    k > 1 the kept gates renormalize to sum to one (standard top-2
+    convention)."""
     import jax
     import jax.numpy as jnp
 
-    logits = x @ params["router"]
+    # bound by the router's width (the GLOBAL expert count) — inside
+    # shard_map params hold only the local expert slab
+    n_experts = params["router"].shape[-1]
+    if not 1 <= k <= n_experts:
+        raise ValueError(f"k={k} must be in [1, {n_experts}]")
+    logits = x @ jnp.asarray(params["router"])
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_ids = jnp.argmax(probs, axis=-1)  # [B, L]
-    gates = jnp.max(probs, axis=-1)  # [B, L]
+    gates, expert_ids = jax.lax.top_k(probs, k)
+    if k > 1:
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return gates, expert_ids
+
+
+def moe_ffn(params: Params, x, k: int = 1):
+    """Dense oracle: top-``k`` routed MoE FFN, all experts local.
+    ``x``: [B, L, D] -> [B, L, D]."""
+    gates, expert_ids = _route_topk(params, x, k)
     return _expert_partials(params, x, 0, gates, expert_ids)
 
 
-def moe_ffn_sharded(params: Params, x, axis_name: str = EXPERT_AXIS):
+def moe_ffn_sharded(
+    params: Params, x, axis_name: str = EXPERT_AXIS, k: int = 1
+):
     """Per-shard body (call inside ``shard_map``): params hold this chip's
     expert slab (leading expert axis sharded over ``axis_name``), ``x`` is
     replicated. Router runs replicated; local experts compute masked
-    partials; one ``psum`` combines."""
+    partials; one ``psum`` combines. Top-k composes for free here: a
+    token's k experts may live on different chips, each contributing its
+    gate-scaled partial to the same psum."""
     import jax
-    import jax.numpy as jnp
 
     my = jax.lax.axis_index(axis_name)
     n_local = params["w_up"].shape[0]
-
-    logits = x @ params["router"]
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert_ids = jnp.argmax(probs, axis=-1)
-    gates = jnp.max(probs, axis=-1)
+    gates, expert_ids = _route_topk(params, x, k)
     partial = _expert_partials(
         params, x, my * n_local, gates, expert_ids
     )
@@ -124,7 +139,7 @@ def moe_ffn_sharded(params: Params, x, axis_name: str = EXPERT_AXIS):
 
 
 @functools.lru_cache(maxsize=32)
-def _moe_program(mesh, axis_name: str):
+def _moe_program(mesh, axis_name: str, k: int = 1):
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -137,7 +152,7 @@ def _moe_program(mesh, axis_name: str):
     }
     return jax.jit(
         jax.shard_map(
-            functools.partial(moe_ffn_sharded, axis_name=axis_name),
+            functools.partial(moe_ffn_sharded, axis_name=axis_name, k=k),
             mesh=mesh,
             in_specs=(expert_sharded, P()),
             out_specs=P(),
@@ -149,10 +164,12 @@ def _moe_program(mesh, axis_name: str):
     )
 
 
-def moe_apply(params: Params, x, mesh=None, axis_name: str = EXPERT_AXIS):
+def moe_apply(
+    params: Params, x, mesh=None, axis_name: str = EXPERT_AXIS, k: int = 1
+):
     """Full-array entry point: shards the expert slabs over the mesh's
-    ``axis_name`` axis and applies the MoE FFN. ``n_experts`` must divide
-    by the axis size."""
+    ``axis_name`` axis and applies the top-``k`` routed MoE FFN.
+    ``n_experts`` must divide by the axis size."""
     import jax
 
     if mesh is None:
@@ -166,7 +183,9 @@ def moe_apply(params: Params, x, mesh=None, axis_name: str = EXPERT_AXIS):
             f"n_experts={n_experts} must divide by the {axis_name!r} axis "
             f"size {n}"
         )
-    return _moe_program(mesh, axis_name)(params, x)
+    if not 1 <= k <= n_experts:  # fail fast, before tracing
+        raise ValueError(f"k={k} must be in [1, {n_experts}]")
+    return _moe_program(mesh, axis_name, k)(params, x)
 
 
 # ---------------------------------------------------------------------------
@@ -189,10 +208,9 @@ def _dispatch_body(params, x, capacity, axis_name):
     t_local, d = x.shape
     n_local = params["w_up"].shape[0]
 
-    logits = x @ jnp.asarray(params["router"])
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)          # global expert id [T]
-    gate = jnp.max(probs, axis=-1)               # [T]
+    gates1, ids1 = _route_topk(params, x, 1)     # dispatch is top-1
+    expert = ids1[..., 0]                        # global expert id [T]
+    gate = gates1[..., 0]                        # [T]
     dst = expert // n_local                      # destination chip [T]
     local_e = expert % n_local                   # expert id on that chip
 

@@ -269,3 +269,63 @@ class TestPipelineDataParallel:
                 _stage_fn, params, np.zeros((8, 8), np.float32),
                 n_micro=2, mesh=mesh, batch_axis="pp",
             )
+
+
+class TestTopKRouting:
+    def test_top2_matches_manual_oracle(self, nprng):
+        import jax
+        from tensorframes_tpu.parallel import init_moe, moe_ffn
+
+        params = init_moe(0, d_model=8, d_ff=16, n_experts=4)
+        x = jnp.asarray(nprng.normal(size=(2, 6, 8)).astype(np.float32))
+        out = np.asarray(moe_ffn(params, x, k=2))
+
+        # manual: renormalized top-2 gate-weighted expert outputs
+        probs = np.asarray(jax.nn.softmax(x @ params["router"], axis=-1))
+        want = np.zeros_like(np.asarray(x))
+        order = np.argsort(-probs, axis=-1)
+        for b in range(2):
+            for t in range(6):
+                ids = order[b, t, :2]
+                g = probs[b, t, ids]
+                g = g / g.sum()
+                acc = np.zeros(8, np.float32)
+                for gi, e in zip(g, ids):
+                    h = np.asarray(jax.nn.gelu(
+                        np.asarray(x)[b, t] @ params["w_up"][e] + params["b_up"][e]
+                    ))
+                    y = h @ params["w_down"][e] + params["b_down"][e]
+                    acc += gi * y
+                want[b, t] = acc
+        np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+    def test_top2_sharded_matches_oracle(self, nprng):
+        from tensorframes_tpu.parallel import init_moe, moe_apply, moe_ffn
+
+        mesh = make_mesh({"ep": 4})
+        params = init_moe(1, d_model=8, d_ff=16, n_experts=8)
+        x = jnp.asarray(nprng.normal(size=(2, 12, 8)).astype(np.float32))
+        out = moe_apply(params, x, mesh=mesh, k=2)
+        ref = moe_ffn(params, x, k=2)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_k1_unchanged(self, nprng):
+        from tensorframes_tpu.parallel import init_moe, moe_ffn
+
+        params = init_moe(2, d_model=8, d_ff=16, n_experts=4)
+        x = jnp.asarray(nprng.normal(size=(1, 8, 8)).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(moe_ffn(params, x)),
+            np.asarray(moe_ffn(params, x, k=1)),
+        )
+
+    def test_bad_k_rejected(self, nprng):
+        from tensorframes_tpu.parallel import init_moe, moe_apply
+
+        mesh = make_mesh({"ep": 4})
+        params = init_moe(0, d_model=8, d_ff=16, n_experts=4)
+        x = jnp.zeros((1, 4, 8), jnp.float32)
+        with pytest.raises(ValueError, match="k="):
+            moe_apply(params, x, mesh=mesh, k=5)
