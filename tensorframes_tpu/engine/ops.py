@@ -479,6 +479,22 @@ def map_blocks(
 # ---------------------------------------------------------------------------
 
 
+def _concat_dense(ps: List) -> Any:
+    """Concatenate per-chunk result arrays into one dense column buffer:
+    single piece passes through untouched (keeps device residency), any
+    numpy piece forces a host concatenate, all-device pieces concatenate on
+    device."""
+    import jax.numpy as jnp
+
+    if len(ps) == 1:
+        return ps[0]
+    if any(isinstance(p, np.ndarray) for p in ps):
+        return np.ascontiguousarray(
+            np.concatenate([np.asarray(p) for p in ps], axis=0)
+        )
+    return jnp.concatenate(ps, axis=0)
+
+
 def _map_rows_thunk(
     parent: TensorFrame,
     binding: Dict[str, str],
@@ -487,6 +503,7 @@ def _map_rows_thunk(
     result_info: FrameInfo,
     run_bucket: Callable[[Dict[str, np.ndarray], int], Dict[str, Any]],
     result_partitions: Optional[int] = None,
+    device_resident: bool = True,
 ):
     """Shared row-map execution: bucket rows by input cell shape, assemble
     each bucket's batched feed (dense gather / ragged gather-pad / stack),
@@ -511,18 +528,48 @@ def _map_rows_thunk(
             return TensorFrame(cols, result_info)
         col_data = {ph: parent.column_data(col) for ph, col in binding.items()}
         # bucket rows by the tuple of input cell shapes (one compiled
-        # program per bucket shape; the jit cache handles specialization)
+        # program per bucket shape; the jit cache handles specialization).
+        # Dense columns have ONE cell shape by construction, so their key
+        # component is a constant — a frame of only dense columns is a
+        # single bucket with no per-row work (and no host materialization
+        # via cell()); only ragged columns' cells are visited.
         buckets: Dict[Tuple, List[int]] = {}
-        for i in range(n):
-            key = tuple(col_data[ph].cell(i).shape for ph in binding)
-            buckets.setdefault(key, []).append(i)
+        dense_keys = {
+            ph: cd.dense.shape[1:]
+            for ph, cd in col_data.items()
+            if cd.dense is not None
+        }
+        dense_fast = len(dense_keys) == len(col_data)
+        if dense_fast:
+            # the index list is only read by the fallback loop; build it
+            # there (range(n) boxed as a 10M-int list is real memory)
+            pass
+        else:
+            for i in range(n):
+                key = tuple(
+                    dense_keys[ph]
+                    if ph in dense_keys
+                    else col_data[ph].cells[i].shape
+                    for ph in binding
+                )
+                buckets.setdefault(key, []).append(i)
         # ragged 1-D columns pack once into (flat, offsets) so bucket
         # stacking is a native gather instead of a Python stack loop
         ragged_bufs: Dict[str, RaggedBuffer] = {}
         for ph, cd in col_data.items():
             if cd.dense is None and cd.cells[0].ndim == 1:
                 ragged_bufs[ph] = RaggedBuffer.from_cells(cd.cells)
-        out_cells: Dict[str, List] = {name: [None] * n for name in fetch_names}
+        # dense_fast: chunks run in row order over the one bucket, so chunk
+        # outputs concatenate straight into dense result columns — no
+        # per-row scatter list, no _build_column re-stack of n cells
+        dense_pieces: Dict[str, List[np.ndarray]] = {
+            name: [] for name in fetch_names
+        }
+        out_cells: Dict[str, List] = (
+            {}
+            if dense_fast
+            else {name: [None] * n for name in fetch_names}
+        )
         from ..utils import get_config
 
         # buckets larger than the per-call row cap run in chunks: the input
@@ -533,11 +580,21 @@ def _map_rows_thunk(
 
         def run_chunk(sub):
             idx_arr = np.asarray(sub, dtype=np.int64)
+            contiguous = bool(
+                idx_arr.size
+                and idx_arr[-1] - idx_arr[0] + 1 == idx_arr.size
+                and np.all(np.diff(idx_arr) == 1)
+            )
             feed = {}
             for ph in binding:
                 cd = col_data[ph]
                 if cd.dense is not None:
-                    feed[ph] = gather_rows(cd.host(), idx_arr)
+                    h = cd.host()
+                    feed[ph] = (
+                        h[idx_arr[0] : idx_arr[-1] + 1]
+                        if contiguous
+                        else gather_rows(h, idx_arr)
+                    )
                 elif ph in ragged_bufs:
                     feed[ph] = ragged_bufs[ph].gather_pad(idx_arr)
                 else:
@@ -577,16 +634,87 @@ def _map_rows_thunk(
                 raise
             for name in fetch_names:
                 arr = np.asarray(res[name])
-                for j, i in enumerate(sub):
-                    out_cells[name][i] = arr[j]
+                if dense_fast:
+                    dense_pieces[name].append(arr)
+                else:
+                    for j, i in enumerate(sub):
+                        out_cells[name][i] = arr[j]
 
-        for _, idxs in buckets.items():
-            for lo in range(0, len(idxs), chunk):
-                run_chunk(idxs[lo : lo + chunk])
-        cols: Dict[str, _ColumnData] = {}
-        for name in fetch_names:
-            cd, _ = _build_column(name, out_cells[name])
-            cols[name] = cd
+        def run_dense_fast() -> Optional[Dict[str, _ColumnData]]:
+            """Device-resident execution for the all-dense single bucket:
+            columns feed from memoized device copies (``_block_feeder``),
+            chunks slice ON DEVICE and dispatch without per-chunk host
+            syncs (each host round-trip costs ~40-100ms on a
+            tunnel-attached TPU), and results concatenate on device — the
+            same residency contract as ``map_blocks``. Returns ``None``
+            when HBM would not stay bounded (streaming inputs, over-budget
+            or unknown-size outputs) or on any runtime failure, in which
+            case the synchronous chunked path (retry + OOM halving) runs
+            instead."""
+            import jax
+
+            feeders = {}
+            for ph in binding:
+                feeders[ph], streams = _block_feeder(col_data[ph])
+                if streams:
+                    return None
+            budget = get_config().device_cache_bytes
+            est = 0
+            for spec in out_specs.values():
+                cell = spec.shape
+                if any(d == Unknown for d in cell.dims):
+                    return None
+                est += (
+                    int(np.prod(cell.dims)) if cell.dims else 1
+                ) * spec.scalar_type.np_dtype.itemsize * n
+            if est > budget:
+                return None
+            pieces: Dict[str, List] = {name: [] for name in fetch_names}
+            try:
+                for lo in range(0, n, chunk):
+                    hi = min(lo + chunk, n)
+                    feed = {ph: feeders[ph](lo, hi) for ph in binding}
+                    res = run_bucket(feed, hi - lo)
+                    for name in fetch_names:
+                        pieces[name].append(res[name])
+                cols: Dict[str, _ColumnData] = {}
+                for name in fetch_names:
+                    # sync (no transfer) so async failures surface in this
+                    # window, not later in user code
+                    arr = jax.block_until_ready(
+                        _concat_dense(pieces[name])
+                    )
+                    cols[name] = _ColumnData(dense=arr)
+                return cols
+            except Exception:
+                logger.warning(
+                    "map_rows device-resident path failed; falling back "
+                    "to synchronous chunked execution",
+                    exc_info=True,
+                )
+                return None
+
+        cols = (
+            run_dense_fast() if dense_fast and device_resident else None
+        )
+        if cols is None:
+            if dense_fast and not buckets:
+                buckets[tuple(dense_keys[ph] for ph in binding)] = list(
+                    range(n)
+                )
+            for _, idxs in buckets.items():
+                for lo in range(0, len(idxs), chunk):
+                    run_chunk(idxs[lo : lo + chunk])
+            cols = {}
+            if dense_fast:
+                for name in fetch_names:
+                    cols[name] = _ColumnData(
+                        dense=_concat_dense(dense_pieces[name])
+                    )
+            else:
+                for name in fetch_names:
+                    cd, _ = _build_column(name, out_cells[name])
+                    cols[name] = cd
         for c in parent.schema:
             cols[c.name] = parent.column_data(c.name)
         if result_partitions is not None:
@@ -1007,28 +1135,73 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
             }
 
     else:
-        # binary or mixed keys: one O(n) host pass assigns integer codes by
-        # first appearance; the sort over codes still runs on device
-        cols = [
-            kd.cells if kd.is_binary else kd.host() for kd in key_cds
-        ]
-        mapping: Dict[Any, int] = {}
-        codes = np.empty(n, dtype=np.int64)
-        single = len(cols) == 1
-        for i in range(n):
-            kv = cols[0][i] if single else tuple(
-                bytes(c[i]) if isinstance(c[i], (bytes, bytearray))
-                else c[i].item()
-                for c in cols
+        # binary or mixed keys: assign integer codes by first appearance,
+        # vectorized. Per column, a *provisional* injective coding (any
+        # group numbering) is computed; the stacked provisional codes are
+        # renumbered in one final np.unique pass so output group order is
+        # first appearance — exactly the old per-row dict loop's order,
+        # without its 10M-iteration interpreter cost. The sort over codes
+        # still runs on device.
+        def first_appearance_codes(arr, axis=None):
+            _, first, inv = np.unique(
+                arr, axis=axis, return_index=True, return_inverse=True
             )
-            if isinstance(kv, (bytes, bytearray)):
-                kv = bytes(kv)
-            elif isinstance(kv, np.generic):
-                kv = kv.item()
-            code = mapping.get(kv)
-            if code is None:
-                code = mapping[kv] = len(mapping)
-            codes[i] = code
+            rank = np.empty(len(first), dtype=np.int64)
+            rank[np.argsort(first, kind="stable")] = np.arange(len(first))
+            return rank[inv.reshape(-1)]
+
+        def binary_codes(cells) -> np.ndarray:
+            # fixed-width S array (a trailing 0x01 sentinel defeats numpy's
+            # trailing-NUL stripping, keeping keys that differ only in
+            # trailing NULs distinct) — unless one outlier key would make
+            # the n x max_len buffer balloon past ~8x the actual bytes, in
+            # which case the O(total bytes) dict loop is the cheaper pass
+            lengths = np.fromiter(
+                (len(c) for c in cells), dtype=np.int64, count=n
+            )
+            padded = n * (int(lengths.max(initial=0)) + 1)
+            total = int(lengths.sum()) + n
+            if padded > max(total * 8, 1 << 26):
+                mapping: Dict[bytes, int] = {}
+                out = np.empty(n, dtype=np.int64)
+                for i, c in enumerate(cells):
+                    c = bytes(c)
+                    code = mapping.get(c)
+                    if code is None:
+                        code = mapping[c] = len(mapping)
+                    out[i] = code
+                return out
+            arr = np.asarray([bytes(c) + b"\x01" for c in cells])
+            _, inv = np.unique(arr, return_inverse=True)
+            return inv.reshape(-1).astype(np.int64)
+
+        def numeric_codes(vals: np.ndarray) -> np.ndarray:
+            # NaN semantics must match the dense-numeric path and the old
+            # dict loop: NaN != NaN, so every NaN row is its own group.
+            # np.unique would collapse NaNs; give each NaN row a fresh
+            # provisional code instead.
+            if np.issubdtype(vals.dtype, np.floating):
+                nan = np.isnan(vals)
+                if nan.any():
+                    out = np.empty(n, dtype=np.int64)
+                    _, inv = np.unique(vals[~nan], return_inverse=True)
+                    out[~nan] = inv.reshape(-1)
+                    k = n - int(nan.sum())
+                    out[nan] = k + np.arange(int(nan.sum()))
+                    return out
+            _, inv = np.unique(vals, return_inverse=True)
+            return inv.reshape(-1).astype(np.int64)
+
+        per_col = [
+            binary_codes(kd.cells) if kd.is_binary else numeric_codes(kd.host())
+            for kd in key_cds
+        ]
+        if len(per_col) == 1:
+            codes = first_appearance_codes(per_col[0])
+        else:
+            codes = first_appearance_codes(
+                np.stack(per_col, axis=1), axis=0
+            )
         codes_dev = jnp.asarray(codes)
         order_dev = jnp.argsort(codes_dev, stable=True)
         sorted_c = codes_dev[order_dev]

@@ -379,6 +379,11 @@ def map_rows(
         result_info,
         run_bucket=run_bucket,
         result_partitions=ndev,
+        # the sharded run_bucket feeds jit(shard_map) programs that expect
+        # dp-sharded rows; the local engine's _block_feeder whole-column
+        # device copy is the wrong residency for that path, so the
+        # device-resident dense fast path is disabled here
+        device_resident=False,
     )
     return TensorFrame({}, result_info, num_partitions=ndev, _thunk=thunk)
 

@@ -97,3 +97,29 @@ def test_trim_multi_fetch_row_count_mismatch_raises():
     )
     with pytest.raises(ValueError, match="disagree on the output row count"):
         bad.cache()
+
+
+def test_dense_map_rows_output_is_device_resident():
+    # the all-dense single-bucket map_rows path keeps results in HBM like
+    # map_blocks (no per-chunk host transfers), chunked by the per-call cap
+    old = get_config().max_rows_per_device_call
+    set_config(max_rows_per_device_call=7)  # forces multiple chunks
+    try:
+        x = np.arange(32, dtype=np.float32)
+        df = tft.TensorFrame.from_columns({"x": x})
+        out = tft.map_rows(lambda x: {"y": x * 2.0}, df)
+        cd = out.column_data("y")
+        assert _is_device_array(cd.dense)
+        np.testing.assert_allclose(cd.host(), x * 2.0)
+    finally:
+        set_config(max_rows_per_device_call=old)
+
+
+def test_dense_map_rows_streams_on_small_budget(small_budget):
+    # over-budget columns keep the synchronous chunked path (host results)
+    x = np.arange(512, dtype=np.float64)
+    df = tft.TensorFrame.from_columns({"x": x})
+    out = tft.map_rows(lambda x: {"y": x + 1.0}, df)
+    cd = out.column_data("y")
+    assert isinstance(cd.dense, np.ndarray)
+    np.testing.assert_allclose(cd.dense, x + 1.0)

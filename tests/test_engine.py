@@ -400,6 +400,53 @@ class TestAggregateGeneralKeys:
         got = sorted((r.a, r.b, r.x) for r in out.collect())
         assert got == [(1, 0, 17.0), (1, 1, 2.0), (2, 0, 12.0)]
 
+    def test_nan_float_key_rows_stay_separate_groups(self):
+        # NaN != NaN: the old per-row dict coding and the pure-numeric
+        # device path both give every NaN row its own group; the
+        # vectorized mixed-key coding must match (np.unique alone would
+        # collapse NaNs into one group)
+        df = tft.TensorFrame.from_rows(
+            [
+                {"s": b"a", "f": np.nan, "x": 1.0},
+                {"s": b"a", "f": np.nan, "x": 2.0},
+                {"s": b"a", "f": 1.0, "x": 4.0},
+                {"s": b"b", "f": 1.0, "x": 8.0},
+            ]
+        )
+        out = tft.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)},
+            df.group_by("s", "f"),
+        )
+        got = sorted(r.x for r in out.collect())
+        assert got == [1.0, 2.0, 4.0, 8.0]
+
+    def test_trailing_nul_keys_stay_distinct(self):
+        df = tft.TensorFrame.from_rows(
+            [
+                {"k": b"a", "x": 1.0},
+                {"k": b"a\x00", "x": 2.0},
+                {"k": b"a\x00\x00", "x": 4.0},
+                {"k": b"a", "x": 8.0},
+            ]
+        )
+        out = tft.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)}, df.group_by("k")
+        )
+        got = sorted(r.x for r in out.collect())
+        assert got == [2.0, 4.0, 9.0]
+
+    def test_outlier_long_key_uses_bounded_memory_path(self):
+        # one huge key forces the O(total bytes) dict fallback instead of
+        # an n x max_len fixed-width buffer; semantics are identical
+        rows = [{"k": b"k%d" % (i % 3), "x": 1.0} for i in range(64)]
+        rows.append({"k": b"z" * (1 << 21), "x": 100.0})
+        df = tft.TensorFrame.from_rows(rows)
+        out = tft.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)}, df.group_by("k")
+        )
+        got = sorted(r.x for r in out.collect())
+        assert got == [21.0, 21.0, 22.0, 100.0]
+
     def test_ragged_key_rejected(self):
         df = tft.TensorFrame.from_rows(
             [{"k": [1.0]}, {"k": [1.0, 2.0]}]
