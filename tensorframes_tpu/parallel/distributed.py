@@ -111,14 +111,24 @@ def _cached_program(g, key, build: Callable[[], Any]):
         prog = build()
 
         def dispatch(*a, _prog=prog, _key=key, **k):
-            def _run():
-                import jax
+            import jax
 
+            def _run():
                 # sync inside the retry window — async failures would
                 # otherwise surface later, past the handler; distributed
                 # results are materialized promptly by their callers
                 return jax.block_until_ready(_prog(*a, **k))
 
+            if jax.process_count() > 1:
+                # no local retries in a multi-process run: a transient
+                # error seen by ONE process would re-enter the collective
+                # program alone while peers that succeeded do not, leaving
+                # the retried collectives without matching participants
+                # (a silent hang at the Gloo/DCN barrier). Fail fast and
+                # let the job-level restart (checkpoint/resume) recover —
+                # the same contract as a lost Spark executor taking down
+                # the stage in the reference.
+                return _run()
             return run_with_retries(_run, what=f"distributed program {_key}")
 
         cache[key] = dispatch

@@ -31,7 +31,10 @@ __all__ = [
     "local_rows",
     "sync_global",
     "map_blocks",
+    "map_rows",
     "reduce_blocks",
+    "reduce_rows",
+    "aggregate",
 ]
 
 
@@ -262,3 +265,259 @@ def reduce_blocks(fetches, local_df, mesh):
     res = prog(feed)
     host = {f: sync_global(res[f]) for f in g.fetch_names}
     return _unpack_reduce_result(host, g.fetch_names)
+
+
+# ---------------------------------------------------------------------------
+# map_rows / reduce_rows / aggregate: the rest of the op surface
+# ---------------------------------------------------------------------------
+
+
+def map_rows(fetches, local_df, mesh, feed_dict=None):
+    """Multi-host row-wise map. All five frame ops run through the
+    distributed plane, matching the reference where every op executes
+    inside the cluster (row maps run inside Spark tasks,
+    ``DebugRowOps.scala:396-477``).
+
+    Execution picks the shape that fits the data:
+
+    - **dense frames** (every bound column has one cell shape): one global
+      program — each process contributes its rows via ``global_batch`` and
+      a ``vmap`` of the row graph runs over the globally row-sharded
+      array; results come back as this process's rows.
+    - **ragged / binary frames**: rows with differing cell shapes compile
+      per shape bucket, and bucket membership is a property of *local*
+      data — so each process maps its own rows with the local engine, the
+      exact analog of the reference's partition-local row loop (a Spark
+      row map never leaves its executor either). No cross-process
+      rendezvous is needed because a row map carries no cross-row
+      dataflow.
+
+    Returns a local frame of this process's result rows (fetch columns
+    followed by the input columns), like :func:`map_blocks`.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..engine.ops import _as_graph, _ensure_precision
+    from ..engine.validation import (
+        check_output_collisions,
+        validate_map_inputs,
+    )
+    from ..frame import TensorFrame
+    from .distributed import _cached_program
+    from .mesh import DATA_AXIS
+
+    g = _as_graph(fetches, local_df, cell_inputs=True, feed_dict=feed_dict)
+    binding = validate_map_inputs(g, local_df.schema, block=False)
+    dense = all(
+        local_df.schema[col].scalar_type.name != "binary"
+        and local_df.column_data(col).dense is not None
+        for col in binding.values()
+    )
+    if not dense:
+        from ..engine import map_rows as local_map_rows
+
+        return local_map_rows(g, local_df)  # feed_dict already merged
+    _ensure_precision(g, local_df.schema)
+    input_shapes = {
+        ph: local_df.schema[col].cell_shape for ph, col in binding.items()
+    }
+    out_specs = g.analyze(input_shapes, share_lead=False)
+    check_output_collisions(out_specs, local_df.schema)
+    feed = {
+        ph: global_batch(local_df.column_data(col).host(), mesh)
+        for ph, col in binding.items()
+    }
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    prog = _cached_program(
+        g,
+        (mesh, "mh_map_rows"),
+        lambda: jax.jit(
+            jax.vmap(g.fn),
+            out_shardings={f: sharding for f in g.fetch_names},
+        ),
+    )
+    res = prog(feed)
+    cols = {name: _local_rows_of(res[name]) for name in g.fetch_names}
+    for c in local_df.schema:
+        cols[c.name] = local_df.column_data(c.name).host()
+    return TensorFrame.from_columns(cols)
+
+
+def reduce_rows(fetches, local_df, mesh):
+    """Multi-host pairwise row reduce: one ``shard_map`` program over the
+    global mesh — per-shard ``lax.scan`` fold, ``all_gather`` of the
+    per-shard partials (ICI within a host, DCN across hosts), and an
+    on-device fold of the user's merge graph. Every process returns the
+    same value; no driver funnel (reference:
+    ``DebugRowOps.scala:479-501``, executors→driver).
+
+    The global row count must divide the mesh size (each process's rows
+    already split evenly by ``local_rows``; pad or trim to a multiple of
+    the device count).
+    """
+    import jax
+    from jax import lax
+
+    from ..engine.ops import (
+        _as_graph,
+        _ensure_precision,
+        _unpack_reduce_result,
+    )
+    from ..engine.validation import validate_reduce_row_graph
+    from .distributed import _cached_program, _dp_spec
+    from .mesh import DATA_AXIS
+
+    g = _as_graph(fetches, local_df, cell_inputs=True)
+    binding = validate_reduce_row_graph(g, local_df.schema)
+    for col in binding.values():
+        local_df.column_block(col, None)
+    _ensure_precision(g, local_df.schema)
+    fetch_names = list(g.fetch_names)
+    ndev = int(np.prod(list(mesh.shape.values())))
+    n_local = local_df.num_rows
+    if n_local == 0:
+        raise ValueError("reduce_rows on an empty frame")
+    n_global = n_local * process_count()
+    if n_global % ndev != 0:
+        raise ValueError(
+            f"{n_global} global rows do not shard evenly over {ndev} "
+            f"devices; pad or trim to a multiple of the device count"
+        )
+
+    def merge(a, b):
+        feed = {}
+        for f in fetch_names:
+            feed[f"{f}_1"] = a[f]
+            feed[f"{f}_2"] = b[f]
+        return g.fn(feed)
+
+    def prog_body(feed):
+        init = {f: feed[f][0] for f in fetch_names}
+        rest = {f: feed[f][1:] for f in fetch_names}
+
+        def body(c, x):
+            return merge(c, x), None
+
+        local, _ = lax.scan(body, init, rest)
+        gathered = {
+            f: lax.all_gather(local[f], DATA_AXIS) for f in fetch_names
+        }
+        init = {f: gathered[f][0] for f in fetch_names}
+        rest = {f: gathered[f][1:] for f in fetch_names}
+        out, _ = lax.scan(body, init, rest)
+        # one identical [1, ...] row per shard; any addressable shard
+        # holds the final value
+        return {f: out[f][None] for f in fetch_names}
+
+    feed = {
+        f: global_batch(local_df.column_data(col).host(), mesh)
+        for f, col in binding.items()
+    }
+    prog = _cached_program(
+        g,
+        (mesh, "mh_reduce_rows"),
+        lambda: jax.jit(
+            jax.shard_map(
+                prog_body,
+                mesh=mesh,
+                in_specs=({f: _dp_spec() for f in fetch_names},),
+                out_specs=_dp_spec(),
+            )
+        ),
+    )
+    res = prog(feed)
+    acc = {
+        f: np.asarray(res[f].addressable_shards[0].data)[0]
+        for f in fetch_names
+    }
+    return _unpack_reduce_result(acc, fetch_names)
+
+
+def _allgather_partials(partials_df):
+    """Exchange each process's (small) partial-aggregate table so every
+    process holds the global partial set.
+
+    Group counts differ per process, and ``process_allgather`` requires
+    identical shapes — so counts are gathered first, every column is
+    padded to the max count, gathered, then trimmed per process and
+    concatenated. Binary key columns ride as (lengths, fixed-width uint8)
+    pairs sized by the gathered max key length. Partial tables are one row
+    per locally-seen group — the only data that crosses hosts, same as the
+    reference's partial-aggregation shuffle (``DebugRowOps.scala:547-592``).
+    """
+    from jax.experimental import multihost_utils
+
+    from ..frame import TensorFrame
+
+    ag = multihost_utils.process_allgather
+    nproc = process_count()
+    local_n = partials_df.num_rows
+    counts = np.asarray(
+        ag(np.asarray([local_n], dtype=np.int64))
+    ).reshape(nproc)
+    maxc = int(counts.max())
+
+    def gather_numeric(arr):
+        pad_shape = (maxc - local_n,) + arr.shape[1:]
+        padded = np.concatenate(
+            [arr, np.zeros(pad_shape, dtype=arr.dtype)], axis=0
+        )
+        stacked = np.asarray(ag(padded))  # [P, maxc, ...]
+        return np.concatenate(
+            [stacked[p, : counts[p]] for p in range(nproc)], axis=0
+        )
+
+    cols = {}
+    for ci in partials_df.schema:
+        cd = partials_df.column_data(ci.name)
+        if ci.scalar_type.name == "binary":
+            cells = [bytes(c) for c in cd.cells]
+            lens = np.asarray(
+                [len(c) for c in cells] + [0] * (maxc - local_n),
+                dtype=np.int64,
+            )
+            maxlen = int(np.asarray(ag(lens.max(initial=0))).max())
+            buf = np.zeros((maxc, maxlen), dtype=np.uint8)
+            for i, c in enumerate(cells):
+                buf[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+            all_lens = np.asarray(ag(lens))  # [P, maxc]
+            all_buf = np.asarray(ag(buf))  # [P, maxc, maxlen]
+            out = []
+            for p in range(nproc):
+                for i in range(int(counts[p])):
+                    out.append(
+                        all_buf[p, i, : all_lens[p, i]].tobytes()
+                    )
+            cols[ci.name] = out
+        else:
+            cols[ci.name] = gather_numeric(cd.host())
+    return TensorFrame.from_columns(cols)
+
+
+def aggregate(fetches, grouped_data, mesh):
+    """Multi-host keyed aggregation, two-phase partial/final:
+
+    1. each process aggregates its LOCAL rows with the full local engine
+       (device sort + segmented associative scan over this host's chips),
+       yielding one partial row per locally-seen group;
+    2. the small partial tables are all-gathered across processes and a
+       replicated final aggregate merges same-key partials — every
+       process returns the identical global result.
+
+    The shuffle the reference leans on (``DebugRowOps.scala:547-592``)
+    moves raw rows between executors; here only per-group partials cross
+    hosts. Keys may be numeric, binary, or multi-column mixes, same as
+    the local engine.
+    """
+    from ..engine import aggregate as local_aggregate
+    from ..engine.ops import _as_graph
+    from ..frame import GroupedFrame
+
+    local_df = grouped_data.frame
+    keys = grouped_data.keys
+    g = _as_graph(fetches, local_df, cell_inputs=False)
+    partials = local_aggregate(g, grouped_data)._force()
+    global_partials = _allgather_partials(partials).analyze()
+    g2 = g.with_inputs({f"{f}_input": f for f in g.fetch_names})
+    return local_aggregate(g2, GroupedFrame(global_partials, keys))

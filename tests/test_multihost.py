@@ -86,6 +86,136 @@ def _free_port():
         return s.getsockname()[1]
 
 
+_WORKER4 = r"""
+import json, sys
+import numpy as np
+from tensorframes_tpu.parallel import multihost
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+multihost.initialize(
+    f"localhost:{port}", num_processes=4, process_id=pid, local_device_count=2
+)
+import jax
+assert jax.process_count() == 4 and len(jax.devices()) == 8
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.parallel import make_mesh
+
+mesh = make_mesh({"dp": 8})
+data = np.arange(48, dtype=np.float32)  # conceptual global column
+rows = multihost.local_rows(48)
+local_df = tft.TensorFrame.from_columns({"x": data[rows]})
+
+# row map over the global mesh: each process feeds 12 rows, gets its 12 back
+mapped = multihost.map_rows(lambda x: {"y": x * 3.0 + 1.0}, local_df, mesh)
+local_y = [float(r.y) for r in mapped.collect()]
+
+# pairwise row reduce: per-shard fold + all_gather + merge fold, replicated
+total = multihost.reduce_rows(
+    lambda x_1, x_2: {"x": x_1 + x_2}, local_df, mesh
+)
+
+# keyed aggregation with binary keys; group counts DIFFER per process
+# (process p sees groups g0..g{p}) so the padded partial exchange is
+# actually exercised
+names = [b"g%d" % min(i // 3, pid) for i in range(12)]
+kdf = tft.TensorFrame.from_columns(
+    {"k": names, "v": np.arange(12, dtype=np.float32) + 100.0 * pid}
+)
+agg = multihost.aggregate(
+    lambda v_input: {"v": v_input.sum(axis=0)}, kdf.group_by("k"), mesh
+)
+agg_rows = sorted((r.k.decode(), float(r.v)) for r in agg.collect())
+
+# ragged rows run the partition-local path: still correct per process
+rg = tft.TensorFrame.from_rows(
+    [{"v": [1.0] * (1 + (pid + i) % 3)} for i in range(4)]
+).analyze()
+rr = multihost.map_rows(lambda v: {"s": v.sum()}, rg, mesh)
+ragged_sums = [float(r.s) for r in rr.collect()]
+
+print(f"RESULT{pid} " + json.dumps(
+    {"local_y": local_y, "total": float(total), "agg": agg_rows,
+     "ragged": ragged_sums}
+), flush=True)
+"""
+
+
+@pytest.fixture(scope="module")
+def four_process_result(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mh4")
+    worker = d / "worker4.py"
+    worker.write_text(_WORKER4)
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        for i in range(4)
+    ]
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+    results = {}
+    for i, (out, _) in enumerate(outs):
+        line = next(
+            l for l in out.splitlines() if l.startswith(f"RESULT{i} ")
+        )
+        results[i] = json.loads(line[len(f"RESULT{i} "):])
+    return results
+
+
+class TestFourProcess:
+    """4 processes x 2 devices: all five frame ops distributed, vs oracle."""
+
+    def test_map_rows_returns_local_slice_transformed(
+        self, four_process_result
+    ):
+        data = np.arange(48, dtype=np.float32)
+        for pid in range(4):
+            np.testing.assert_allclose(
+                four_process_result[pid]["local_y"],
+                (data[pid * 12 : (pid + 1) * 12] * 3.0 + 1.0).tolist(),
+            )
+
+    def test_reduce_rows_replicated_global_fold(self, four_process_result):
+        for pid in range(4):
+            assert four_process_result[pid]["total"] == float(
+                np.arange(48).sum()
+            )
+
+    def test_aggregate_uneven_groups_match_oracle(self, four_process_result):
+        # single-process oracle over the union of all four local tables
+        oracle = {}
+        for pid in range(4):
+            names = [f"g{min(i // 3, pid)}" for i in range(12)]
+            vals = np.arange(12, dtype=np.float32) + 100.0 * pid
+            for k, v in zip(names, vals):
+                oracle[k] = oracle.get(k, 0.0) + float(v)
+        expect = sorted((k, v) for k, v in oracle.items())
+        for pid in range(4):
+            got = [tuple(r) for r in four_process_result[pid]["agg"]]
+            assert got == expect, (pid, got, expect)
+
+    def test_ragged_map_rows_partition_local(self, four_process_result):
+        for pid in range(4):
+            expect = [float(1 + (pid + i) % 3) for i in range(4)]
+            assert four_process_result[pid]["ragged"] == expect
+
+
 @pytest.fixture(scope="module")
 def two_process_result(tmp_path_factory):
     d = tmp_path_factory.mktemp("mh")
