@@ -1,4 +1,4 @@
-"""The five BASELINE.md benchmark configs.
+"""The BASELINE.md benchmark configs, plus framework-specific extras (7+).
 
 Each function runs one config and returns a result dict; ``run_all.py``
 prints them as JSON lines. ``bench.py`` at the repo root runs config 3 (the
@@ -22,15 +22,20 @@ from typing import Dict
 import numpy as np
 
 
+def _sync(v):
+    """A REAL device barrier: ``block_until_ready`` alone is advisory on
+    relayed/tunneled PJRT devices (measured returning in ms for 200ms+ of
+    queued work on the axon tunnel), so a 1-element host readback forces
+    execution to actually finish inside the timing window."""
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+        np.asarray(v.ravel()[:1])
+    return v
+
+
 def _timeit(fn, iters=5, warmup=1):
     """Wall time per call; the returned value of ``fn`` is synchronized so
     async device dispatch cannot leak out of the timing window."""
-
-    def _sync(v):
-        if hasattr(v, "block_until_ready"):
-            v.block_until_ready()
-        return v
-
     for _ in range(warmup):
         _sync(fn())
     t0 = time.perf_counter()
@@ -131,28 +136,47 @@ def config4_image_scoring(n_rows: int = 100_000) -> Dict:
     raws = [pool[i].tobytes() for i in range(n_rows)]
     df = tft.TensorFrame.from_columns({"image_data": raws}, num_partitions=16)
 
-    # host codec stage, measured alone
+    # host codec stage, measured alone (chunked thread-pool decode with
+    # dense chunk assembly — was 2.80s in round 2, per-cell futures)
     t0 = time.perf_counter()
     decoded = df.decode_column("image_data", scorer.decode).cache().analyze()
     dt_decode = time.perf_counter() - t0
 
     # chip scoring stage over the decoded frame: the first pass pays the
-    # host->HBM transfer (memoized per column), later passes measure the
-    # conv pipeline itself — the reference analog is repeated scoring of a
-    # resident dataset, and it isolates chip rate from tunnel bandwidth
+    # host->HBM transfer (memoized per column) + XLA compile, later passes
+    # measure the conv pipeline itself — the reference analog is repeated
+    # scoring of a resident dataset, and it isolates chip rate from tunnel
+    # bandwidth
     def run():
         out = scorer.score_frame(decoded, "image_data")
-        emb = out.cache().column_block("embedding")
+        emb = out.cache().column_data("embedding").dense
         assert emb.shape == (n_rows, 256)
         return emb
 
     t0 = time.perf_counter()
-    run()
+    _sync(run())
     dt_first = time.perf_counter() - t0
     dt = _timeit(run, iters=2, warmup=0)
+
+    # overlapped single-shot: decode runs on the pool several partitions
+    # AHEAD of the chip (map_blocks decoders=), one end-to-end pass over
+    # fresh binary rows. On this box the number is LINK-bound: each pass
+    # moves the full decoded 307MB host->device through the ~70MB/s
+    # tunnel; on a real TPU host (PCIe) the same path is compute-bound.
+    def run_overlapped():
+        out = scorer.score_frame(df, "image_data")
+        return out.cache().column_data("embedding").dense
+
+    t0 = time.perf_counter()
+    _sync(run_overlapped())
+    dt_overlap = time.perf_counter() - t0
+
+    # per-pass cost of a resident dataset = chip pass; decode amortizes
+    # once per dataset. rows_per_sec counts BOTH (decode + one chip pass),
+    # matching how round 2's number was scored.
     return {
         "metric": "config4_image_scoring_rows_per_sec",
-        "value": round(n_rows / dt, 1),
+        "value": round(n_rows / (dt + dt_decode), 1),
         "unit": "rows/s",
         "seconds_per_pass": round(dt, 4),
         "decode_seconds_per_pass": round(dt_decode, 4),
@@ -160,6 +184,7 @@ def config4_image_scoring(n_rows: int = 100_000) -> Dict:
         # components are not separable without a second compile, so this is
         # reported as one labeled number rather than a fake decomposition
         "first_pass_seconds_incl_compile_and_transfer": round(dt_first, 4),
+        "overlapped_fresh_ingest_seconds_per_pass": round(dt_overlap, 4),
         "model": "cnn6-bf16-32x32x3-embed256",
     }
 
@@ -204,12 +229,32 @@ def config5_distributed_sgd(
         w = step(w)
     dt = (time.perf_counter() - t0) / steps
     err = float(np.linalg.norm(w - w_true) / np.linalg.norm(w_true))
+
+    # ORACLE: a numpy SGD running the IDENTICAL schedule (same init, lr,
+    # step count, full-batch gradient). rel_param_error vs w_true only
+    # measures convergence progress and cannot catch a wrong gradient;
+    # the oracle delta can.
+    w_oracle = np.zeros(dim, dtype=np.float32)
+    for _ in range(steps + 1):  # +1: the warmup step also updated w
+        err_vec = x @ w_oracle - y
+        w_oracle = w_oracle - lr * (x * err_vec[:, None]).sum(axis=0)
+    oracle_delta = float(
+        np.linalg.norm(w - w_oracle) / (np.linalg.norm(w_oracle) + 1e-12)
+    )
+    # tolerance sized for backends whose default matmul precision is
+    # bf16: a wrong gradient produces O(1) deltas, rounding drift stays
+    # well under this (measured 1.3e-6 on the tunneled v5e)
+    assert oracle_delta < 5e-2, (
+        f"df-ops SGD diverged from the numpy oracle running the same "
+        f"schedule: {oracle_delta}"
+    )
     return {
         "metric": "config5_sgd_rows_per_sec",
         "value": round(n_rows / dt, 1),
         "unit": "rows/s",
         "seconds_per_step": round(dt, 4),
         "rel_param_error": round(err, 4),
+        "oracle_rel_delta": round(oracle_delta, 8),
     }
 
 
@@ -272,6 +317,163 @@ def config6_grouped_aggregate(
     }
 
 
+def config7_dense_map_rows(n_rows: int = 1_000_000) -> Dict:
+    """1M-row dense ``map_rows`` vs the equivalent ``map_blocks``: the
+    all-dense single-bucket fast path (device feeds, on-device chunk
+    slicing/concat, no per-chunk host round-trips) should keep row-wise
+    semantics within ~2x of block execution end to end (result pulled to
+    host in both, so both pay one full transfer)."""
+    import tensorframes_tpu as tft
+
+    x = np.random.default_rng(0).normal(size=n_rows).astype(np.float32)
+    df = tft.TensorFrame.from_columns({"x": x}).analyze()
+
+    def row_fn(x):
+        return {"y": x * 2.0 + 1.0}
+
+    def blk_fn(x):
+        return {"z": x * 2.0 + 1.0}
+
+    def run_rows():
+        return tft.map_rows(row_fn, df).cache().column_data("y").host()
+
+    def run_blocks():
+        return tft.map_blocks(blk_fn, df).cache().column_data("z").host()
+
+    dt_rows = _timeit(run_rows, iters=3)
+    dt_blocks = _timeit(run_blocks, iters=3)
+    np.testing.assert_allclose(run_rows(), x * 2.0 + 1.0, rtol=1e-6)
+    return {
+        "metric": "config7_dense_map_rows_rows_per_sec",
+        "value": round(n_rows / dt_rows, 1),
+        "unit": "rows/s",
+        "seconds_per_pass": round(dt_rows, 4),
+        "map_blocks_seconds_per_pass": round(dt_blocks, 4),
+        "vs_map_blocks": round(dt_rows / dt_blocks, 3),
+    }
+
+
+def config8_string_key_aggregate(
+    n_rows: int = 10_000_000, n_groups: int = 1024
+) -> Dict:
+    """10M-row aggregate grouped by a STRING key: key coding is vectorized
+    (np.unique over a fixed-width byte array, first-appearance renumber) —
+    the old per-row dict loop spent the whole pass in the interpreter.
+    Reports coding time vs everything-else time."""
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.engine.ops import _group_sort_impl
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=n_rows).astype(np.float32)
+    gid = rng.integers(0, n_groups, size=n_rows)
+    # one bytes pool sliced per row: building 10M bytes objects is frame
+    # construction cost, not aggregation cost
+    names = np.char.add("grp_", gid.astype("U8")).astype("S12")
+    keys = [bytes(names[i]) for i in range(n_rows)]
+    df = tft.TensorFrame.from_columns({"k": keys, "x": x}).analyze()
+    grouped = df.group_by("k")
+
+    def agg_fn(x_input):
+        return {"x": x_input.sum(axis=0)}
+
+    def run():
+        return tft.aggregate(agg_fn, grouped).cache().column_data("x").host()
+
+    dt = _timeit(run, iters=2)
+
+    # key coding + device sort measured on a FRESH frame after everything
+    # is warm (the sort permutation memoizes per frame, which is the
+    # production behavior but would hide the per-dataset cost; a cold
+    # frame before warmup would charge XLA compiles to coding)
+    df2 = tft.TensorFrame.from_columns({"k": keys, "x": x}).analyze()
+    t0 = time.perf_counter()
+    _group_sort_impl(df2, ["k"], {})
+    dt_coding = time.perf_counter() - t0
+    got = run()
+    assert got.shape[0] == n_groups
+    np.testing.assert_allclose(float(got.sum()), float(x.sum()), rtol=1e-3)
+    # the sort permutation (and its coding pass) memoizes per frame, so
+    # the timed passes above exclude coding; fresh data pays both, which
+    # is what value reports
+    return {
+        "metric": "config8_string_key_aggregate_rows_per_sec",
+        "value": round(n_rows / (dt + dt_coding), 1),
+        "unit": "rows/s",
+        "seconds_per_pass_memoized_sort": round(dt, 4),
+        "key_coding_and_sort_seconds": round(dt_coding, 4),
+        "n_groups": n_groups,
+    }
+
+
+def config9_kmeans(
+    n_rows: int = 1_000_000, dim: int = 16, k: int = 32, iters: int = 10
+) -> Dict:
+    """Lloyd k-means through the df ops (in-graph pre-aggregation +
+    reduce merge, the reference demo's optimized pattern,
+    ``kmeans_demo.py:101-171``), vs a numpy oracle running the IDENTICAL
+    schedule (same seeded init, same update rule) — the oracle delta
+    catches a wrong assignment/update, which a convergence curve cannot.
+    Per iteration the host sees only the [k,d]+[k] partials (a few KB);
+    the O(n*k*d) distance work stays on the MXU."""
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.models import kmeans
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, dim)).astype(np.float32)
+    # well-separated planted clusters so the oracle path is stable
+    x += rng.normal(size=(k, dim)).astype(np.float32)[
+        rng.integers(0, k, size=n_rows)
+    ] * 4.0
+    df = tft.TensorFrame.from_columns({"features": x}).analyze()
+
+    kmeans(df, "features", k=k, num_iters=1, seed=1)  # warmup/compile
+    t0 = time.perf_counter()
+    cents, _ = kmeans(df, "features", k=k, num_iters=iters, seed=1)
+    dt = (time.perf_counter() - t0) / iters
+
+    # numpy oracle, identical schedule
+    def numpy_lloyd():
+        r = np.random.default_rng(1)
+        c = x[r.choice(n_rows, size=k, replace=False)].astype(x.dtype)
+        for _ in range(iters):
+            d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(axis=-1)
+            closest = np.argmin(d2, axis=1)
+            nc = c.copy()
+            for j in range(k):
+                m = closest == j
+                if m.any():
+                    nc[j] = x[m].mean(axis=0)
+            if np.linalg.norm(nc - c) == 0.0:
+                c = nc
+                break
+            c = nc
+        return c
+
+    t0 = time.perf_counter()
+    c_oracle = numpy_lloyd()
+    dt_numpy = (time.perf_counter() - t0) / iters
+    oracle_delta = float(
+        np.linalg.norm(cents - c_oracle) / np.linalg.norm(c_oracle)
+    )
+    # argmin assignments are exact (elementwise f32 distances); only the
+    # mean update can pick up rounding, so the bound stays tight
+    assert oracle_delta < 1e-3, (
+        f"kmeans centroids diverged from the numpy oracle running the "
+        f"same schedule: {oracle_delta}"
+    )
+    return {
+        "metric": "config9_kmeans_rows_per_sec_per_iter",
+        "value": round(n_rows / dt, 1),
+        "unit": "rows/s",
+        "seconds_per_iter": round(dt, 4),
+        "numpy_seconds_per_iter": round(dt_numpy, 4),
+        "vs_numpy": round(dt_numpy / dt, 2),
+        "oracle_rel_delta": round(oracle_delta, 8),
+        "k": k,
+        "dim": dim,
+    }
+
+
 ALL_CONFIGS = {
     1: config1_add3,
     2: config2_vector_reduce,
@@ -279,4 +481,7 @@ ALL_CONFIGS = {
     4: config4_image_scoring,
     5: config5_distributed_sgd,
     6: config6_grouped_aggregate,
+    7: config7_dense_map_rows,
+    8: config8_string_key_aggregate,
+    9: config9_kmeans,
 }

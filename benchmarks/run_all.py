@@ -1,4 +1,4 @@
-"""Run all five BASELINE configs; one JSON line each.
+"""Run the benchmark configs (BASELINE's six + framework extras); one JSON line each.
 
 Usage: ``python benchmarks/run_all.py [config_numbers...]``
 (no args = all). Runs on whatever backend jax selects (TPU when attached).

@@ -89,6 +89,7 @@ def _as_graph(
     cell_inputs: bool,
     feed_dict: Optional[Dict[str, str]] = None,
     constants: Optional[Dict[str, Any]] = None,
+    schema: Optional[FrameInfo] = None,
 ) -> CapturedGraph:
     """Accept the three frontend forms and return a CapturedGraph.
 
@@ -108,7 +109,9 @@ def _as_graph(
     ):
         g = build_graph(list(fetches))
     elif callable(fetches):
-        g = _graph_from_callable(fetches, df, cell_inputs, feed_dict, constants)
+        g = _graph_from_callable(
+            fetches, df, cell_inputs, feed_dict, constants, schema=schema
+        )
     else:
         raise TypeError(
             f"fetches must be Node(s), a CapturedGraph, or a callable; got "
@@ -134,12 +137,14 @@ def _graph_from_callable(
     cell_inputs: bool,
     feed_dict: Optional[Dict[str, str]],
     constants: Optional[Dict[str, Any]] = None,
+    schema: Optional[FrameInfo] = None,
 ) -> CapturedGraph:
     """Plain-function frontend: parameter names are placeholder names, bound
     to columns directly or via feed_dict / reduce suffixes, or to per-call
     ``constants`` arrays."""
     from ..schema import for_numpy_dtype
 
+    schema = schema if schema is not None else df.schema
     params = [
         p.name
         for p in inspect.signature(fn).parameters.values()
@@ -154,12 +159,12 @@ def _graph_from_callable(
             arr = np.asarray(constants[p])
             specs[p] = (for_numpy_dtype(arr.dtype), Shape(arr.shape))
             continue
-        col = resolve_column(p, feed_dict or {}, df.schema.names)
+        col = resolve_column(p, feed_dict or {}, schema.names)
         if col is None:
             missing.append(p)
             continue
         bound[p] = col
-        info = df.schema[col]
+        info = schema[col]
         if cell_inputs:
             shape = info.cell_shape
         elif p.endswith("_input"):
@@ -169,7 +174,7 @@ def _graph_from_callable(
             shape = info.block_shape.with_lead(Unknown)
         specs[p] = (info.scalar_type, shape)
     if missing:
-        raise InputNotFoundError(missing, df.schema.names)
+        raise InputNotFoundError(missing, schema.names)
     # memoize per function object + spec signature: a fn defined once and
     # passed to an op repeatedly (e.g. inside an iterative algorithm) keeps
     # one CapturedGraph and therefore one compiled program
@@ -299,12 +304,38 @@ def _empty_output(spec: TensorSpec, block_output: bool) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _resolve_decoder_cols(
+    decoders: Dict[str, Callable],
+    feed_dict: Optional[Dict[str, str]],
+    schema_names: Sequence[str],
+) -> Dict[str, Callable]:
+    """Decoder keys are column names, or placeholder names routed through
+    ``feed_dict`` (explicit feed_dict routing wins: a placeholder may
+    collide with an unrelated column name). Returns column -> codec."""
+    out: Dict[str, Callable] = {}
+    for key, fn in decoders.items():
+        if feed_dict and key in feed_dict:
+            col = feed_dict[key]
+        elif key in schema_names:
+            col = key
+        else:
+            raise InputNotFoundError([key], schema_names)
+        out[col] = fn
+    return out
+
+
+#: partitions of decoded blocks kept in flight ahead of the device: decode
+#: of partition p+1..p+N proceeds on the host pool while the chip runs p
+_DECODE_PREFETCH = 4
+
+
 def map_blocks(
     fetches,
     dframe: TensorFrame,
     trim: bool = False,
     feed_dict: Optional[Dict[str, str]] = None,
     constants: Optional[Dict[str, Any]] = None,
+    decoders: Optional[Dict[str, Callable]] = None,
 ) -> TensorFrame:
     """Transform the frame block by block; fetches become new columns
     (``trim=False``) or the entire output (``trim=True``, row count may
@@ -314,19 +345,73 @@ def map_blocks(
     on the block shape, so frames with equal-sized partitions compile once.
     ``constants`` feed placeholders with per-call host arrays (same shape ->
     no recompile), for iterative algorithms like k-means centroids.
+
+    ``decoders`` maps a binary column (or its placeholder) to a host codec
+    ``bytes -> array``; that column then feeds the program as decoded
+    numeric blocks, with decode running on a thread pool several
+    partitions AHEAD of the device — host codec work overlaps chip compute
+    instead of serializing before it (the reference gets this overlap from
+    Spark's partition iterator feeding the TF session,
+    ``DebugRowOps.scala:766-803``; here it is explicit double-buffering).
+    The decoded shape/dtype is probed from row 0; all rows must decode to
+    that shape (varying shapes: use ``map_rows``, which shape-buckets).
+    The result frame carries the ORIGINAL (undecoded) columns — decoded
+    blocks are transient feed buffers, never a materialized column.
     """
+    decode_fns: Dict[str, Callable] = {}
+    probe_cells: Dict[str, np.ndarray] = {}
+    schema = dframe.schema
+    if decoders:
+        from ..frame.table import _as_cell
+        from ..schema import for_numpy_dtype
+
+        decode_fns = _resolve_decoder_cols(
+            decoders, feed_dict, schema.names
+        )
+        if dframe.num_rows == 0:
+            raise ValueError(
+                "map_blocks(decoders=...) on an empty frame (no row to "
+                "probe the decoded schema from)"
+            )
+        infos = []
+        for ci in schema:
+            if ci.name in decode_fns:
+                probe = _as_cell(
+                    decode_fns[ci.name](
+                        dframe.column_data(ci.name).cell(0)
+                    )
+                )
+                if isinstance(probe, bytes):
+                    raise TypeError(
+                        f"decoder for column {ci.name!r} produced bytes; "
+                        f"block programs need numeric cells"
+                    )
+                probe_cells[ci.name] = probe
+                infos.append(
+                    ColumnInfo(
+                        ci.name,
+                        for_numpy_dtype(probe.dtype),
+                        analyzed_shape=Shape(
+                            [Unknown] + list(probe.shape)
+                        ),
+                        nesting=probe.ndim,
+                    )
+                )
+            else:
+                infos.append(ci)
+        schema = FrameInfo(infos)
     g = _as_graph(
         fetches, dframe, cell_inputs=False, feed_dict=feed_dict,
-        constants=constants,
+        constants=constants, schema=schema,
     )
     binding = validate_map_inputs(
-        g, dframe.schema, block=True, constants=set(constants or ())
+        g, schema, block=True, constants=set(constants or ())
     )
     # ragged/binary columns are rejected when blocks are materialized in the
     # thunk (column_block raises), keeping construction metadata-only/lazy
-    _ensure_precision(g, dframe.schema)
+    _ensure_precision(g, schema)
     input_shapes = {
-        ph: dframe.schema[col].block_shape.with_lead(Unknown)
+        ph: schema[col].block_shape.with_lead(Unknown)
         for ph, col in binding.items()
     }
     out_specs = g.analyze(input_shapes)
@@ -362,10 +447,72 @@ def map_blocks(
 
         pieces: Dict[str, List] = {n: [] for n in fetch_names}
         part_sizes: List[int] = []
+        # decoded columns feed through a PREFETCHING codec: partition p's
+        # block is decoded on the pool while the chip still runs earlier
+        # partitions, and decode for p+1..p+N is submitted the moment p's
+        # block is consumed
+        decode_pool = None
+        decode_futs: Dict[Tuple[str, int], Any] = {}
+        bounds = list(parent.partition_bounds())
+        part_of = {tuple(b): i for i, b in enumerate(bounds)}
+
+        def _submit_decode(col: str, p: int) -> None:
+            if (col, p) in decode_futs or p >= len(bounds):
+                return
+            lo, hi = bounds[p]
+            fn = decode_fns[col]
+            cd = parent.column_data(col)
+            pc = probe_cells[col]
+
+            def job(lo=lo, hi=hi, fn=fn, cd=cd, pc=pc):
+                if hi == lo:
+                    return np.empty((0,) + pc.shape, dtype=pc.dtype)
+                cells = []
+                for i in range(lo, hi):
+                    if i == 0:
+                        # row 0 was decoded by the schema probe; reuse it
+                        # (a stateful or expensive codec must not run
+                        # twice per row)
+                        cells.append(np.asarray(pc))
+                        continue
+                    c = np.asarray(fn(cd.cell(i)))
+                    if c.shape != pc.shape:
+                        raise ValueError(
+                            f"decoder for column {col!r} produced shape "
+                            f"{c.shape} at row {i}, but row 0 probed "
+                            f"{pc.shape}; block programs need uniform "
+                            f"decoded shapes (use map_rows for varying "
+                            f"ones)"
+                        )
+                    cells.append(c)
+                return np.stack(cells).astype(pc.dtype, copy=False)
+
+            decode_futs[(col, p)] = decode_pool.submit(job)
+
+        def _make_decode_feeder(col: str):
+            def feeder(lo: int, hi: int) -> np.ndarray:
+                p = part_of[(lo, hi)]
+                _submit_decode(col, p)
+                for q in range(p + 1, p + 1 + _DECODE_PREFETCH):
+                    _submit_decode(col, q)
+                return decode_futs.pop((col, p)).result()
+
+            return feeder
+
         # device-resident columns when they fit; streamed blocks otherwise
         feeders = {}
         streaming = False
         for ph, col in binding.items():
+            if col in decode_fns:
+                if decode_pool is None:
+                    import os
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    decode_pool = ThreadPoolExecutor(
+                        min(32, os.cpu_count() or 1)
+                    )
+                feeders[ph] = _make_decode_feeder(col)
+                continue
             parent.column_block(col, None)  # rejects ragged/binary
             feeders[ph], streams = _block_feeder(parent.column_data(col))
             streaming = streaming or streams
@@ -388,66 +535,92 @@ def map_blocks(
         # track actual accumulated bytes and demote to host streaming the
         # moment the budget is crossed mid-run
         acc_bytes = 0
-        for p in range(parent.num_partitions):
-            lo, hi = parent.partition_bounds()[p]
-            n = hi - lo
-            if n == 0:
-                part_sizes.append(0)
-                continue
-            feed = {ph: feeders[ph](lo, hi) for ph in binding}
-            feed.update(const_feed)
-            from ..utils import is_oom, run_with_retries
+        # streaming materialization is WINDOWED (double-buffered): pulling a
+        # partition's output to host blocks the host until that transfer
+        # lands, so materializing the append immediately would serialize
+        # transfer against the next partition's dispatch. Keeping a couple
+        # of partitions in flight lets the device run ahead while earlier
+        # outputs stream down; peak HBM stays at ~window+1 blocks, which is
+        # the streaming mode's contract.
+        from collections import deque
 
-            # NOTE: map_blocks keeps results device-resident so chained
-            # passes pipeline without host syncs (the 20x headline win in
-            # bench.py). The deliberate cost: only errors raised at
-            # DISPATCH are retried/classified here — a failure during
-            # async execution surfaces later, at materialization. map_rows
-            # and the reduces, which materialize promptly, sync inside
-            # their retry windows and get full coverage.
-            try:
-                res = run_with_retries(
-                    lambda: jit_fn(feed), what=f"map_blocks partition {p}"
-                )
-            except Exception as e:
-                if is_oom(e):
-                    from ..utils.failures import DeviceOOMError
+        STREAM_WINDOW = 2
+        pending: "deque[int]" = deque()
 
-                    raise DeviceOOMError(
-                        f"map_blocks partition {p} ({n} rows) exhausted "
-                        f"device memory; repartition the frame into smaller "
-                        f"blocks (block programs see a whole partition, so "
-                        f"the engine cannot split one for you)"
-                    ) from e
-                raise
-            # results stay device-resident: shape checks need no host sync,
-            # and the host transfer happens only on host access (collect /
-            # column host materialization) — chained ops feed from HBM
-            out_n = None
-            for name in fetch_names:
-                arr = res[name]
-                if not trim and arr.shape[0] != n:
-                    raise ValueError(
-                        f"map_blocks output {name!r} produced {arr.shape[0]} "
-                        f"rows for a block of {n}; only trimmed maps may "
-                        f"change the row count"
+        def drain_pending(to_size: int) -> None:
+            while len(pending) > to_size:
+                idx = pending.popleft()
+                for nm in fetch_names:
+                    pieces[nm][idx] = np.asarray(pieces[nm][idx])
+
+        try:
+            for p in range(parent.num_partitions):
+                lo, hi = bounds[p]
+                n = hi - lo
+                if n == 0:
+                    part_sizes.append(0)
+                    continue
+                feed = {ph: feeders[ph](lo, hi) for ph in binding}
+                feed.update(const_feed)
+                from ..utils import is_oom, run_with_retries
+
+                # NOTE: map_blocks keeps results device-resident so chained
+                # passes pipeline without host syncs (the 20x headline win in
+                # bench.py). The deliberate cost: only errors raised at
+                # DISPATCH are retried/classified here — a failure during
+                # async execution surfaces later, at materialization. map_rows
+                # and the reduces, which materialize promptly, sync inside
+                # their retry windows and get full coverage.
+                try:
+                    res = run_with_retries(
+                        lambda: jit_fn(feed), what=f"map_blocks partition {p}"
                     )
-                if trim and out_n is not None and arr.shape[0] != out_n:
-                    raise ValueError(
-                        f"map_blocks(trim=True) fetches disagree on the "
-                        f"output row count in partition {p}: {name!r} "
-                        f"produced {arr.shape[0]} rows, a previous fetch "
-                        f"produced {out_n}"
-                    )
-                out_n = arr.shape[0]
-                if not streaming:
-                    acc_bytes += arr.nbytes
-                    if acc_bytes > budget:
-                        streaming = True
-                        for nm in fetch_names:  # demote what's accumulated
-                            pieces[nm] = [np.asarray(a) for a in pieces[nm]]
-                pieces[name].append(np.asarray(arr) if streaming else arr)
-            part_sizes.append(out_n if trim else n)
+                except Exception as e:
+                    if is_oom(e):
+                        from ..utils.failures import DeviceOOMError
+
+                        raise DeviceOOMError(
+                            f"map_blocks partition {p} ({n} rows) exhausted "
+                            f"device memory; repartition the frame into smaller "
+                            f"blocks (block programs see a whole partition, so "
+                            f"the engine cannot split one for you)"
+                        ) from e
+                    raise
+                # results stay device-resident: shape checks need no host sync,
+                # and the host transfer happens only on host access (collect /
+                # column host materialization) — chained ops feed from HBM
+                out_n = None
+                for name in fetch_names:
+                    arr = res[name]
+                    if not trim and arr.shape[0] != n:
+                        raise ValueError(
+                            f"map_blocks output {name!r} produced {arr.shape[0]} "
+                            f"rows for a block of {n}; only trimmed maps may "
+                            f"change the row count"
+                        )
+                    if trim and out_n is not None and arr.shape[0] != out_n:
+                        raise ValueError(
+                            f"map_blocks(trim=True) fetches disagree on the "
+                            f"output row count in partition {p}: {name!r} "
+                            f"produced {arr.shape[0]} rows, a previous fetch "
+                            f"produced {out_n}"
+                        )
+                    out_n = arr.shape[0]
+                    if not streaming:
+                        acc_bytes += arr.nbytes
+                        if acc_bytes > budget:
+                            streaming = True
+                            for nm in fetch_names:  # demote what's accumulated
+                                pieces[nm] = [np.asarray(a) for a in pieces[nm]]
+                    pieces[name].append(arr)
+                if streaming:
+                    pending.append(len(pieces[fetch_names[0]]) - 1)
+                    drain_pending(STREAM_WINDOW)
+                part_sizes.append(out_n if trim else n)
+            drain_pending(0)
+        finally:
+            if decode_pool is not None:
+                decode_pool.shutdown(wait=False, cancel_futures=True)
         cols: Dict[str, _ColumnData] = {}
         for name in fetch_names:
             ps = pieces[name]
@@ -741,15 +914,9 @@ def apply_decoders(
     (``read_image.py:158-160``). Decoding is forced here and the result
     ``analyze``d so downstream capture sees concrete cell shapes (the
     reference likewise requires ``tfs.analyze`` before non-scalar ops)."""
-    for key, fn in decoders.items():
-        # explicit feed_dict routing wins: a placeholder may collide with an
-        # unrelated column name
-        if feed_dict and key in feed_dict:
-            col = feed_dict[key]
-        elif key in dframe.schema.names:
-            col = key
-        else:
-            raise InputNotFoundError([key], dframe.schema.names)
+    for col, fn in _resolve_decoder_cols(
+        decoders, feed_dict, dframe.schema.names
+    ).items():
         dframe = dframe.decode_column(col, fn)
     return dframe.analyze()
 
@@ -1135,13 +1302,18 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
             }
 
     else:
-        # binary or mixed keys: assign integer codes by first appearance,
-        # vectorized. Per column, a *provisional* injective coding (any
-        # group numbering) is computed; the stacked provisional codes are
-        # renumbered in one final np.unique pass so output group order is
-        # first appearance — exactly the old per-row dict loop's order,
-        # without its 10M-iteration interpreter cost. The sort over codes
-        # still runs on device.
+        # binary or mixed keys: integer codes by first appearance.
+        # pandas' hash-based ``factorize`` does this at C speed with no
+        # sort and native first-appearance ordering (measured 0.7s for 10M
+        # bytes keys, vs ~35s for a fixed-width-S np.unique sort and ~10s
+        # for a per-row dict loop); a numpy np.unique path (provisional
+        # codes -> first-appearance renumber) is the no-pandas fallback.
+        # The sort over codes still runs on device.
+        try:
+            import pandas as pd
+        except Exception:  # pragma: no cover - pandas is a std dep here
+            pd = None
+
         def first_appearance_codes(arr, axis=None):
             _, first, inv = np.unique(
                 arr, axis=axis, return_index=True, return_inverse=True
@@ -1151,11 +1323,14 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
             return rank[inv.reshape(-1)]
 
         def binary_codes(cells) -> np.ndarray:
-            # fixed-width S array (a trailing 0x01 sentinel defeats numpy's
-            # trailing-NUL stripping, keeping keys that differ only in
-            # trailing NULs distinct) — unless one outlier key would make
-            # the n x max_len buffer balloon past ~8x the actual bytes, in
-            # which case the O(total bytes) dict loop is the cheaper pass
+            if pd is not None:
+                arr = np.empty(n, dtype=object)
+                arr[:] = [bytes(c) for c in cells]
+                return pd.factorize(arr)[0].astype(np.int64, copy=False)
+            # fallback: fixed-width S array (trailing 0x01 sentinel defeats
+            # numpy's trailing-NUL stripping) unless one outlier key would
+            # balloon the n x max_len buffer, where the O(total bytes)
+            # dict loop is the cheaper pass
             lengths = np.fromiter(
                 (len(c) for c in cells), dtype=np.int64, count=n
             )
@@ -1177,18 +1352,23 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
 
         def numeric_codes(vals: np.ndarray) -> np.ndarray:
             # NaN semantics must match the dense-numeric path and the old
-            # dict loop: NaN != NaN, so every NaN row is its own group.
-            # np.unique would collapse NaNs; give each NaN row a fresh
-            # provisional code instead.
+            # dict loop: NaN != NaN, so every NaN row is its own group
+            # (factorize/np.unique would collapse or sentinel them)
             if np.issubdtype(vals.dtype, np.floating):
                 nan = np.isnan(vals)
                 if nan.any():
                     out = np.empty(n, dtype=np.int64)
-                    _, inv = np.unique(vals[~nan], return_inverse=True)
-                    out[~nan] = inv.reshape(-1)
+                    nn = vals[~nan]
+                    if pd is not None:
+                        out[~nan] = pd.factorize(nn)[0]
+                    else:
+                        _, inv = np.unique(nn, return_inverse=True)
+                        out[~nan] = inv.reshape(-1)
                     k = n - int(nan.sum())
                     out[nan] = k + np.arange(int(nan.sum()))
                     return out
+            if pd is not None:
+                return pd.factorize(vals)[0].astype(np.int64, copy=False)
             _, inv = np.unique(vals, return_inverse=True)
             return inv.reshape(-1).astype(np.int64)
 
@@ -1196,7 +1376,18 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
             binary_codes(kd.cells) if kd.is_binary else numeric_codes(kd.host())
             for kd in key_cds
         ]
-        if len(per_col) == 1:
+        if pd is not None:
+            codes = per_col[0]
+            for nxt in per_col[1:]:
+                # re-factorize after each pairwise combine so the running
+                # code range stays < n and the product cannot overflow
+                codes = pd.factorize(
+                    codes * (np.int64(nxt.max(initial=0)) + 1) + nxt
+                )[0]
+            # final renumber: per-column codes are first-appearance except
+            # for the NaN rows appended at the end of the range
+            codes = pd.factorize(codes)[0].astype(np.int64, copy=False)
+        elif len(per_col) == 1:
             codes = first_appearance_codes(per_col[0])
         else:
             codes = first_appearance_codes(

@@ -508,30 +508,69 @@ class TensorFrame:
         def thunk() -> "TensorFrame":
             cells = list(src.iter_cells())
             n = len(cells)
+
+            def decode_span(span):
+                """Decode one chunk; uniform-shape chunks come back as one
+                stacked dense block (C-level assembly, no 100k-element
+                Python cell list), varying shapes as a cell list."""
+                out = [_as_cell(fn(c)) for c in span]
+                if probe_dtype is None:
+                    return out  # binary decode output: stays cell-wise
+                for i, d in enumerate(out):
+                    if isinstance(d, bytes):
+                        raise TypeError(
+                            f"decode_column({col!r}): row 0 decoded to an "
+                            f"array but a later row decoded to bytes"
+                        )
+                    if not isinstance(d, np.ndarray):
+                        out[i] = np.asarray(d, dtype=probe_dtype)[()]
+                if all(
+                    isinstance(d, np.ndarray) and d.shape == probe.shape
+                    for d in out
+                ):
+                    return np.stack(out).astype(probe_dtype, copy=False)
+                return [
+                    d.astype(probe_dtype, copy=False)
+                    if isinstance(d, np.ndarray)
+                    else d
+                    for d in out
+                ]
+
+            # row 0 was already decoded by the schema probe; reuse it (a
+            # stateful or expensive codec must not run twice per row)
             if num_threads == 0 or (num_threads is None and n < 64):
-                decoded = [_as_cell(fn(c)) for c in cells]
+                parts = [decode_span(cells[1:])] if n > 1 else []
             else:
                 import os
                 from concurrent.futures import ThreadPoolExecutor
 
                 workers = num_threads or min(32, os.cpu_count() or 1)
-                with ThreadPoolExecutor(workers) as ex:
-                    decoded = [_as_cell(v) for v in ex.map(fn, cells)]
-            if probe_dtype is not None:
-                bad = next(
-                    (i for i, d in enumerate(decoded) if isinstance(d, bytes)), None
-                )
-                if bad is not None:
-                    raise TypeError(
-                        f"decode_column({col!r}): row 0 decoded to an array "
-                        f"but row {bad} decoded to bytes"
-                    )
-                decoded = [
-                    d.astype(probe_dtype, copy=False) if isinstance(d, np.ndarray)
-                    else np.asarray(d, dtype=probe_dtype)[()]
-                    for d in decoded
+                # one task per CHUNK, not per cell: futures machinery costs
+                # ~15us/task, which dominates cheap codecs at 100k rows
+                # (measured 1.6s -> 0.1s for a frombuffer codec); real
+                # codecs release the GIL inside the chunk loop just as well
+                chunk = max(64, n // (workers * 4))
+                spans = [
+                    cells[lo : lo + chunk] for lo in range(1, n, chunk)
                 ]
-            cd, _ = _build_column(dst, decoded)
+                with ThreadPoolExecutor(workers) as ex:
+                    parts = list(ex.map(decode_span, spans))
+            if (
+                probe_dtype is not None
+                and all(isinstance(p, np.ndarray) for p in parts)
+            ):
+                # uniform decodes: concatenate chunk blocks straight into
+                # the dense column buffer — one memcpy, no per-cell work
+                first = probe[None].astype(probe_dtype, copy=False)
+                dense = np.concatenate([first] + parts, axis=0)
+                cd = _ColumnData(dense=np.ascontiguousarray(dense))
+            else:
+                decoded = [probe]
+                for p in parts:
+                    decoded.extend(
+                        p if isinstance(p, list) else list(p)
+                    )
+                cd, _ = _build_column(dst, decoded)
             cols: Dict[str, _ColumnData] = {}
             for c in result_info:
                 cols[c.name] = cd if c.name == dst else parent_cols[c.name]
