@@ -15,6 +15,7 @@ All matmuls stay [tokens, d] x [d, d'] so XLA tiles them onto the MXU.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -123,6 +124,42 @@ def _attention(x, block, n_heads, causal, attn_impl, mesh, batch_axis=None):
     return o @ block["proj"]
 
 
+def _dense_block(
+    block, h, n_heads, causal=True, attn_impl="reference", mesh=None,
+    batch_axis=None,
+):
+    """One dense transformer block (pre-LN attention + gelu MLP residuals)
+    — THE block forward, shared by the full-model path
+    (:func:`transformer_logits`) and the pipelined stage
+    (:func:`_pipe_stage_fn`) so the two cannot drift apart."""
+    import jax
+
+    x = h + _attention(
+        _ln(h, block["ln1"]), block, n_heads, causal, attn_impl, mesh,
+        batch_axis,
+    )
+    return x + (
+        jax.nn.gelu(_ln(x, block["ln2"]) @ block["up"]) @ block["down"]
+    )
+
+
+def _head_nll(embed, ln_f, x, targets):
+    """Loss head: final norm + tied unembedding + next-token cross entropy
+    (mean). Shared by the pipelined loss (:func:`_pipe_loss_fn`); the
+    full-model path computes the same math spread across
+    :func:`transformer_logits`/:func:`token_nll` (kept separate there
+    because scoring needs the per-position NLL, not the mean)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = _ln(x, ln_f) @ embed.T
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, targets[..., None].astype(jnp.int32), axis=-1
+    )
+    return -picked[..., 0].mean()
+
+
 def transformer_logits(
     params: Params,
     tokens,
@@ -162,12 +199,12 @@ def transformer_logits(
 
     moe_aux = 0.0
     for block in params["blocks"]:
-        h = _ln(x, block["ln1"])
-        x = x + _attention(
-            h, block, n_heads, causal, attn_impl, mesh, batch_axis
-        )
-        h = _ln(x, block["ln2"])
         if "moe" in block:
+            h = _ln(x, block["ln1"])
+            x = x + _attention(
+                h, block, n_heads, causal, attn_impl, mesh, batch_axis
+            )
+            h = _ln(x, block["ln2"])
             x = x + (
                 moe_apply(block["moe"], h, mesh=mesh)
                 if mesh is not None and EXPERT_AXIS in mesh.axis_names
@@ -176,7 +213,9 @@ def transformer_logits(
             if collect_moe_aux:
                 moe_aux = moe_aux + moe_load_balance_loss(block["moe"], h)
         else:
-            x = x + jax.nn.gelu(h @ block["up"]) @ block["down"]
+            x = _dense_block(
+                block, x, n_heads, causal, attn_impl, mesh, batch_axis
+            )
     x = _ln(x, params["ln_f"])
     logits = x @ embed.T
     if collect_moe_aux:
@@ -227,6 +266,25 @@ def transformer_loss(
     return token_nll(
         params, tokens, attn_impl=attn_impl, mesh=mesh, batch_axis=batch_axis
     ).mean()
+
+
+@functools.lru_cache(maxsize=None)
+def _pipe_stage_fn(n_heads: int):
+    """Stable stage-function object per head count: the compiled pipeline
+    program caches on FUNCTION IDENTITY (see ``parallel.pipeline``), so
+    this must not be recreated per call. Delegates to the SAME block body
+    the full-model path uses (:func:`_dense_block`)."""
+
+    def fn(block, h):
+        return _dense_block(block, h, n_heads)
+
+    return fn
+
+
+def _pipe_loss_fn(extra, y, targets):
+    """Loss head fused into the pipeline's last stage (see
+    :func:`_head_nll`)."""
+    return _head_nll(extra["embed"], extra["ln_f"], y, targets)
 
 
 class TransformerLM:
@@ -328,6 +386,135 @@ class TransformerLM:
                 t, NamedSharding(mesh, P("dp", None))
             ),
         )
+
+    def fit_pipelined(
+        self,
+        tokens: np.ndarray,
+        mesh,
+        steps: int = 10,
+        lr: float = 0.1,
+        n_micro: int = 4,
+        schedule: str = "1f1b",
+        grad_accum: int = 1,
+    ):
+        """SGD with the transformer BLOCKS pipelined over the mesh's ``pp``
+        axis (one block per chip), composed with data parallelism when the
+        mesh has a ``dp`` axis (microbatch rows sharded over it).
+
+        The embedding runs outside the pipeline and trains through the
+        returned input cotangent; the loss head (final norm + tied
+        unembedding + cross entropy) is FUSED into the last stage's
+        backward (:func:`..parallel.pipeline.pipeline_train_step`).
+        ``schedule``: ``'1f1b'`` (bounded activation memory, recompute in
+        backward) or ``'gpipe'`` (autodiff through the forward schedule).
+        ``grad_accum`` splits the batch into that many sequential
+        sub-batches whose grads are averaged before the update — the
+        activation-memory knob beyond microbatching.
+
+        Requires ``len(blocks) == mesh.shape['pp']``, dense (non-MoE)
+        blocks, ``batch/grad_accum`` divisible by ``n_micro`` (and the
+        microbatch by the ``dp`` size when present)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.pipeline import pipeline_train_step
+
+        if "pp" not in mesh.axis_names:
+            raise ValueError(
+                f"fit_pipelined needs a mesh with a 'pp' axis; got "
+                f"{mesh.axis_names}"
+            )
+        batch_axis = "dp" if "dp" in mesh.axis_names else None
+        blocks = self.params["blocks"]
+        if any("moe" in blk for blk in blocks):
+            raise ValueError(
+                "fit_pipelined supports dense blocks; MoE blocks train "
+                "on an ep mesh (see parallel.moe)"
+            )
+        if len(blocks) != mesh.shape["pp"]:
+            raise ValueError(
+                f"{len(blocks)} blocks but pp={mesh.shape['pp']}; the "
+                f"pipeline stages one block per chip"
+            )
+        toks = np.asarray(tokens, dtype=np.int32)
+        b, length = toks.shape
+        if b % grad_accum or (b // grad_accum) % n_micro:
+            raise ValueError(
+                f"batch {b} must divide by grad_accum={grad_accum} and "
+                f"then by n_micro={n_micro}"
+            )
+        n_heads = self.params["n_heads"]
+        stage_fn = _pipe_stage_fn(n_heads)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+        stacked = jax.device_put(stacked, NamedSharding(mesh, P("pp")))
+        p = {
+            "embed": jnp.asarray(self.params["embed"]),
+            "pos": jnp.asarray(self.params["pos"]),
+            "ln_f": jax.tree.map(jnp.asarray, self.params["ln_f"]),
+            "stacked": stacked,
+        }
+        sub = b // grad_accum
+        Lm = length - 1
+
+        def one_chunk(p_, chunk):
+            ti = chunk[:, :-1]
+            tgt = chunk[:, 1:]
+            h0, h_vjp = jax.vjp(
+                lambda e, po: e[ti] + po[:Lm][None], p_["embed"], p_["pos"]
+            )
+            loss, g_stacked, g_extra, dx = pipeline_train_step(
+                stage_fn,
+                _pipe_loss_fn,
+                p_["stacked"],
+                {"embed": p_["embed"], "ln_f": p_["ln_f"]},
+                h0,
+                tgt,
+                n_micro=n_micro,
+                mesh=mesh,
+                batch_axis=batch_axis,
+                schedule=schedule,
+            )
+            de_in, d_pos = h_vjp(dx)
+            grads = {
+                "embed": g_extra["embed"] + de_in,
+                "pos": d_pos,
+                "ln_f": g_extra["ln_f"],
+                "stacked": g_stacked,
+            }
+            return loss, grads
+
+        def step(p_, toks_):
+            chunks = jnp.reshape(toks_, (grad_accum, sub, length))
+            loss, grads = one_chunk(p_, chunks[0])
+            for i in range(1, grad_accum):
+                l2, g2 = one_chunk(p_, chunks[i])
+                loss = loss + l2
+                grads = jax.tree.map(jnp.add, grads, g2)
+            inv = 1.0 / grad_accum
+            new_p = jax.tree.map(
+                lambda a, g: a - lr * (g * inv), p_, grads
+            )
+            return new_p, loss * inv
+
+        step = jax.jit(step)
+        losses = []
+        for _ in range(steps):
+            p, loss = step(p, toks)
+            losses.append(float(loss))
+        host = jax.device_get(p)
+        n_layers = len(blocks)
+        self.params = {
+            "embed": host["embed"],
+            "pos": host["pos"],
+            "blocks": [
+                jax.tree.map(lambda a: a[i], host["stacked"])
+                for i in range(n_layers)
+            ],
+            "ln_f": host["ln_f"],
+            "n_heads": n_heads,
+        }
+        return losses
 
     def score_frame(
         self, df, col: str, loss_col: str = "nll", attn_impl: str = "reference"

@@ -329,3 +329,172 @@ class TestTopKRouting:
         x = jnp.zeros((1, 4, 8), jnp.float32)
         with pytest.raises(ValueError, match="k="):
             moe_apply(params, x, mesh=mesh, k=5)
+
+
+class TestPipelineTraining:
+    """Backward through the pipeline: grads vs the sequential oracle, both
+    schedules, pp alone and composed with dp (VERDICT r2 #3)."""
+
+    @staticmethod
+    def _setup(nprng, B):
+        n, d = 4, 6
+        stages = {
+            "w": nprng.normal(0, 0.3, (n, d, d)).astype(np.float32),
+            "b": nprng.normal(0, 0.1, (n, d)).astype(np.float32),
+        }
+        extra = {"wout": nprng.normal(0, 0.3, (d, 3)).astype(np.float32)}
+        x = nprng.normal(size=(B, d)).astype(np.float32)
+        tgt = nprng.normal(size=(B, 3)).astype(np.float32)
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"] + p["b"])
+
+        def loss_fn(e, y, t):
+            return (((y @ e["wout"]) - t) ** 2).mean()
+
+        return stages, extra, x, tgt, stage_fn, loss_fn
+
+    def _oracle(self, stages, extra, x, tgt, stage_fn, loss_fn, n_micro):
+        from tensorframes_tpu.parallel.pipeline import pipeline_reference
+
+        def total(stages, extra, x):
+            d = x.shape[-1]
+            mb = x.shape[0] // n_micro
+            xm = x.reshape(n_micro, mb, d)
+            tm = tgt.reshape(n_micro, mb, tgt.shape[-1])
+            ls = [
+                loss_fn(
+                    extra, pipeline_reference(stage_fn, stages, xm[i]), tm[i]
+                )
+                for i in range(n_micro)
+            ]
+            return jnp.mean(jnp.asarray(ls))
+
+        return jax.value_and_grad(total, argnums=(0, 1, 2))(
+            stages, extra, x
+        )
+
+    def test_grad_through_pipeline_apply_matches_oracle(self, nprng):
+        from tensorframes_tpu.parallel.pipeline import pipeline_apply
+
+        stages, extra, x, tgt, stage_fn, loss_fn = self._setup(nprng, 8)
+        mesh = make_mesh({"pp": 4})
+        ol, og = self._oracle(
+            stages, extra, x, tgt, stage_fn, loss_fn, n_micro=4
+        )
+
+        def papply_loss(stages, extra, x):
+            y = pipeline_apply(stage_fn, stages, x, n_micro=4, mesh=mesh)
+            return loss_fn(extra, y, tgt)
+
+        gl, gg = jax.value_and_grad(papply_loss, argnums=(0, 1, 2))(
+            stages, extra, x
+        )
+        np.testing.assert_allclose(float(gl), float(ol), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(gg), jax.tree.leaves(og)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5
+            )
+
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    @pytest.mark.parametrize("dp", [1, 2])
+    def test_train_step_matches_oracle(self, nprng, schedule, dp):
+        from tensorframes_tpu.parallel.pipeline import pipeline_train_step
+
+        B = 8 * dp
+        stages, extra, x, tgt, stage_fn, loss_fn = self._setup(nprng, B)
+        mesh = (
+            make_mesh({"pp": 4, "dp": 2}) if dp == 2 else make_mesh({"pp": 4})
+        )
+        ol, og = self._oracle(
+            stages, extra, x, tgt, stage_fn, loss_fn, n_micro=4
+        )
+        loss, gs, ge, dx = pipeline_train_step(
+            stage_fn,
+            loss_fn,
+            stages,
+            extra,
+            x,
+            tgt,
+            n_micro=4,
+            mesh=mesh,
+            batch_axis="dp" if dp == 2 else None,
+            schedule=schedule,
+        )
+        np.testing.assert_allclose(float(loss), float(ol), rtol=1e-5)
+        for a, b in zip(
+            jax.tree.leaves((gs, ge)), jax.tree.leaves((og[0], og[1]))
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5
+            )
+        np.testing.assert_allclose(
+            np.asarray(dx),
+            np.asarray(og[2]).reshape(x.shape),
+            rtol=3e-4,
+            atol=3e-5,
+        )
+
+    def test_unknown_schedule_rejected(self, nprng):
+        from tensorframes_tpu.parallel.pipeline import pipeline_train_step
+
+        stages, extra, x, tgt, stage_fn, loss_fn = self._setup(nprng, 8)
+        with pytest.raises(ValueError, match="schedule"):
+            pipeline_train_step(
+                stage_fn, loss_fn, stages, extra, x, tgt, n_micro=4,
+                mesh=make_mesh({"pp": 4}), schedule="interleaved",
+            )
+
+
+class TestFitPipelined:
+    """TransformerLM.fit_pipelined: full-model training (embedding outside
+    the pipeline, loss head fused into the last stage) must walk the SAME
+    trajectory as single-device fit."""
+
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_losses_match_single_device_fit(self, nprng, schedule):
+        from tensorframes_tpu.models import TransformerLM
+
+        toks = nprng.integers(0, 50, size=(16, 17)).astype(np.int32)
+        kw = dict(vocab=50, d_model=16, n_heads=2, n_layers=4, max_len=32)
+        oracle = TransformerLM.init(3, **kw)
+        o_losses = oracle.fit(toks, steps=3, lr=0.1)
+        m = TransformerLM.init(3, **kw)
+        losses = m.fit_pipelined(
+            toks, make_mesh({"pp": 4, "dp": 2}), steps=3, lr=0.1,
+            n_micro=4, schedule=schedule,
+        )
+        np.testing.assert_allclose(losses, o_losses, rtol=2e-4, atol=2e-5)
+        assert losses[-1] < losses[0]
+
+    def test_grad_accum_same_trajectory(self, nprng):
+        from tensorframes_tpu.models import TransformerLM
+
+        toks = nprng.integers(0, 50, size=(16, 17)).astype(np.int32)
+        kw = dict(vocab=50, d_model=16, n_heads=2, n_layers=4, max_len=32)
+        oracle = TransformerLM.init(3, **kw)
+        o_losses = oracle.fit(toks, steps=3, lr=0.1)
+        m = TransformerLM.init(3, **kw)
+        losses = m.fit_pipelined(
+            toks, make_mesh({"pp": 4}), steps=3, lr=0.1, n_micro=2,
+            schedule="1f1b", grad_accum=2,
+        )
+        np.testing.assert_allclose(losses, o_losses, rtol=2e-4, atol=2e-5)
+
+    def test_moe_blocks_rejected(self, nprng):
+        from tensorframes_tpu.models import TransformerLM
+
+        m = TransformerLM.init(
+            0, vocab=20, d_model=8, n_heads=2, n_layers=4, moe_experts=4
+        )
+        toks = nprng.integers(0, 20, size=(8, 9)).astype(np.int32)
+        with pytest.raises(ValueError, match="dense blocks"):
+            m.fit_pipelined(toks, make_mesh({"pp": 4}), steps=1)
+
+    def test_wrong_stage_count_rejected(self, nprng):
+        from tensorframes_tpu.models import TransformerLM
+
+        m = TransformerLM.init(0, vocab=20, d_model=8, n_heads=2, n_layers=2)
+        toks = nprng.integers(0, 20, size=(8, 9)).astype(np.int32)
+        with pytest.raises(ValueError, match="pp=4"):
+            m.fit_pipelined(toks, make_mesh({"pp": 4}), steps=1)
