@@ -168,13 +168,26 @@ def transformer_logits(
     mesh=None,
     batch_axis=None,
     collect_moe_aux: bool = False,
+    moe_top_k: int = 1,
+    moe_impl: str = "masked",
 ):
     """``tokens`` [B, L] int32 -> logits [B, L, vocab].
 
     ``attn_impl``: "reference" (dense, XLA-fused — best for short L),
     "flash" (Pallas kernel), "ring" (K/V rotation over ``mesh``'s sp
     axis), or "ulysses" (all-to-all head-sharding over the same axis;
-    needs heads divisible by the axis size)."""
+    needs heads divisible by the axis size).
+
+    MoE blocks route top-``moe_top_k``; ``moe_impl`` picks the expert
+    data path on an ``ep`` mesh: "masked" (exact masked compute, every
+    chip sees all tokens) or "dispatch" (Switch all-to-all with capacity
+    buffers — lower FLOPs/communication at scale, drops overflow
+    tokens)."""
+    if moe_impl not in ("masked", "dispatch"):
+        raise ValueError(
+            f"unknown moe_impl {moe_impl!r}; expected 'masked' or "
+            f"'dispatch'"
+        )
     if attn_impl not in ("reference", "flash", "ring", "ulysses"):
         raise ValueError(
             f"unknown attn_impl {attn_impl!r}; expected 'reference', "
@@ -193,6 +206,7 @@ def transformer_logits(
     from ..parallel.moe import (
         EXPERT_AXIS,
         moe_apply,
+        moe_dispatch_apply,
         moe_ffn,
         moe_load_balance_loss,
     )
@@ -205,13 +219,17 @@ def transformer_logits(
                 h, block, n_heads, causal, attn_impl, mesh, batch_axis
             )
             h = _ln(x, block["ln2"])
-            x = x + (
-                moe_apply(block["moe"], h, mesh=mesh)
-                if mesh is not None and EXPERT_AXIS in mesh.axis_names
-                else moe_ffn(block["moe"], h)
-            )
+            if mesh is not None and EXPERT_AXIS in mesh.axis_names:
+                apply = (
+                    moe_dispatch_apply if moe_impl == "dispatch" else moe_apply
+                )
+                x = x + apply(block["moe"], h, mesh=mesh, k=moe_top_k)
+            else:
+                x = x + moe_ffn(block["moe"], h, k=moe_top_k)
             if collect_moe_aux:
-                moe_aux = moe_aux + moe_load_balance_loss(block["moe"], h)
+                moe_aux = moe_aux + moe_load_balance_loss(
+                    block["moe"], h, k=moe_top_k
+                )
         else:
             x = _dense_block(
                 block, x, n_heads, causal, attn_impl, mesh, batch_axis
@@ -225,7 +243,8 @@ def transformer_logits(
 
 def token_nll(
     params: Params, tokens, attn_impl: str = "reference", mesh=None,
-    batch_axis=None, collect_moe_aux: bool = False,
+    batch_axis=None, collect_moe_aux: bool = False, moe_top_k: int = 1,
+    moe_impl: str = "masked",
 ):
     """Per-position next-token negative log-likelihood ``[B, L-1]`` — the
     one implementation both training loss and frame scoring reduce over.
@@ -237,6 +256,7 @@ def token_nll(
     fwd = transformer_logits(
         params, tokens[:, :-1], causal=True, attn_impl=attn_impl, mesh=mesh,
         batch_axis=batch_axis, collect_moe_aux=collect_moe_aux,
+        moe_top_k=moe_top_k, moe_impl=moe_impl,
     )
     logits, aux = fwd if collect_moe_aux else (fwd, None)
     targets = tokens[:, 1:]
@@ -250,7 +270,8 @@ def token_nll(
 
 def transformer_loss(
     params: Params, tokens, attn_impl: str = "reference", mesh=None,
-    batch_axis=None, moe_aux_weight: float = 0.0,
+    batch_axis=None, moe_aux_weight: float = 0.0, moe_top_k: int = 1,
+    moe_impl: str = "masked",
 ):
     """Next-token cross entropy (mean over all predicted positions).
 
@@ -261,10 +282,12 @@ def transformer_loss(
         nll, aux = token_nll(
             params, tokens, attn_impl=attn_impl, mesh=mesh,
             batch_axis=batch_axis, collect_moe_aux=True,
+            moe_top_k=moe_top_k, moe_impl=moe_impl,
         )
         return nll.mean() + moe_aux_weight * aux
     return token_nll(
-        params, tokens, attn_impl=attn_impl, mesh=mesh, batch_axis=batch_axis
+        params, tokens, attn_impl=attn_impl, mesh=mesh,
+        batch_axis=batch_axis, moe_top_k=moe_top_k, moe_impl=moe_impl,
     ).mean()
 
 
@@ -329,9 +352,31 @@ class TransformerLM:
         self.params = {**jax.device_get(p), "n_heads": static}
         return losses
 
-    def fit(self, tokens: np.ndarray, steps: int = 10, lr: float = 0.1):
-        """Plain jitted SGD on next-token loss (single chip)."""
-        return self._sgd_loop(tokens, steps, lr, loss_kwargs={})
+    def fit(
+        self,
+        tokens: np.ndarray,
+        steps: int = 10,
+        lr: float = 0.1,
+        mesh=None,
+        moe_aux_weight: float = 0.0,
+        moe_top_k: int = 1,
+        moe_impl: str = "masked",
+    ):
+        """Jitted SGD on next-token loss. Single chip by default; pass a
+        mesh with an ``ep`` axis to train MoE blocks expert-parallel
+        (``moe_impl``: "masked" exact compute or "dispatch" Switch
+        all-to-all), with ``moe_aux_weight`` adding the load-balancing
+        loss."""
+        kw = {}
+        if mesh is not None:
+            kw["mesh"] = mesh
+        if moe_aux_weight:
+            kw["moe_aux_weight"] = moe_aux_weight
+        if moe_top_k != 1:
+            kw["moe_top_k"] = moe_top_k
+        if moe_impl != "masked":
+            kw["moe_impl"] = moe_impl
+        return self._sgd_loop(tokens, steps, lr, loss_kwargs=kw)
 
     def fit_sharded(
         self,
@@ -517,10 +562,21 @@ class TransformerLM:
         return losses
 
     def score_frame(
-        self, df, col: str, loss_col: str = "nll", attn_impl: str = "reference"
+        self,
+        df,
+        col: str,
+        loss_col: str = "nll",
+        attn_impl: str = "reference",
+        moe_top_k: int = 1,
+        moe_impl: str = "masked",
     ):
         """Per-row next-token NLL appended as a column: the transformer
-        version of frozen-graph scoring through ``map_blocks``."""
+        version of frozen-graph scoring through ``map_blocks``.
+
+        Routing is call-time config, not stored in params: a model
+        trained with ``moe_top_k=2`` must be SCORED with ``moe_top_k=2``
+        or each token gets only its argmax expert — a different network
+        than was trained."""
         import jax.numpy as jnp
 
         from ..engine import map_blocks
@@ -533,9 +589,10 @@ class TransformerLM:
         def fn(**cols):
             toks = cols[col].astype(jnp.int32)
             return {
-                loss_col: token_nll(params, toks, attn_impl=attn_impl).mean(
-                    axis=-1
-                )
+                loss_col: token_nll(
+                    params, toks, attn_impl=attn_impl,
+                    moe_top_k=moe_top_k, moe_impl=moe_impl,
+                ).mean(axis=-1)
             }
 
         import inspect

@@ -9,10 +9,12 @@ Design, TPU-first: experts are sharded over ``ep`` — each chip holds
 every chip runs its local experts over all tokens with the router's
 one-hot mask folded into the expert output, and a single ``psum``
 combines the per-chip partials. Static shapes throughout — no
-capacity buffers, no token dropping, bit-identical to the dense oracle
-(the classic all-to-all token dispatch trades that exactness for lower
-FLOPs at high expert counts; with top-1 routing the masked compute is the
-robust default and the communication is one psum of ``[B, L, D]``).
+capacity buffers, no token dropping, bit-identical to the dense oracle.
+The classic all-to-all token dispatch (:func:`moe_dispatch_apply`) trades
+that exactness for lower FLOPs at high expert counts: per-expert capacity
+buffers (Switch convention), top-k in k dispatch rounds, fully
+differentiable. Both paths train; grads match the dense oracle wherever
+no token dropped.
 """
 
 from __future__ import annotations
@@ -193,80 +195,92 @@ def moe_apply(
 # ---------------------------------------------------------------------------
 
 
-def _dispatch_body(params, x, capacity, axis_name):
+def _dispatch_body(params, x, capacity, axis_name, k):
     """Per-shard body: ``x`` [T_local, D] tokens sharded over ``axis_name``;
-    params hold the local expert slab. Tokens are ROUTED: each chip packs
-    up to ``capacity`` tokens per destination chip into a [n, C, D] buffer,
-    one ``all_to_all`` exchanges them, local experts run on what arrived,
-    and a second ``all_to_all`` returns results to the owning chips.
-    Overflow beyond capacity is dropped (contributes zero) — the standard
-    Switch trade; communication is O(n*C*D) instead of replicating T."""
+    params hold the local expert slab. Tokens are ROUTED: for each of the
+    ``k`` routing slots, every chip packs its tokens into a PER-EXPERT
+    send buffer of ``capacity`` slots (the Switch convention: capacity
+    counts tokens per (source shard, expert), so one expert hogging a
+    chip cannot evict its neighbors' traffic), one ``all_to_all``
+    exchanges the buffers, local experts run on what arrived, and a
+    second ``all_to_all`` returns results to the owning chips. Overflow
+    beyond an expert's capacity is dropped (contributes zero) — the
+    standard Switch trade; communication is O(E*C*D) per slot instead of
+    replicating T. Expert identity travels POSITIONALLY (buffer row =
+    local expert), with a validity mask so empty slots contribute nothing
+    (an expert's bias would otherwise leak into unused slots)."""
     import jax
     import jax.numpy as jnp
 
     n = jax.lax.axis_size(axis_name)
     t_local, d = x.shape
     n_local = params["w_up"].shape[0]
+    n_experts = n * n_local
 
-    gates1, ids1 = _route_topk(params, x, 1)     # dispatch is top-1
-    expert = ids1[..., 0]                        # global expert id [T]
-    gate = gates1[..., 0]                        # [T]
-    dst = expert // n_local                      # destination chip [T]
-    local_e = expert % n_local                   # expert id on that chip
-
-    # position of each token within its destination's send buffer: running
-    # count of earlier tokens with the same destination (stable priority by
-    # position, the Switch convention); >= capacity drops
-    onehot = jax.nn.one_hot(dst, n, dtype=jnp.int32)        # [T, n]
-    pos = (jnp.cumsum(onehot, axis=0) - onehot)[jnp.arange(t_local), dst]
-    keep = pos < capacity
-
-    # scatter tokens into the [n, C, D] send buffer; dropped tokens target
-    # the out-of-bounds slot `capacity` so mode="drop" discards them (a
-    # clipped in-bounds index would clobber a kept token's slot)
-    safe_pos = jnp.where(keep, pos, capacity)
-    send = jnp.zeros((n, capacity, d), x.dtype)
-    send = send.at[dst, safe_pos].set(x, mode="drop")
-    # empty slots carry expert id -1, which matches no local expert — no
-    # separate validity buffer (and no third all_to_all) needed
-    send_e = jnp.full((n, capacity), -1, jnp.int32)
-    send_e = send_e.at[dst, safe_pos].set(local_e, mode="drop")
-
-    # exchange: recv[s] = what chip s sent to me
-    recv = jax.lax.all_to_all(send, axis_name, 0, 0, tiled=False)
-    recv_e = jax.lax.all_to_all(send_e, axis_name, 0, 0, tiled=False)
-
-    toks = recv.reshape(n * capacity, d)
-    te = recv_e.reshape(n * capacity)
-
-    # local experts over the received tokens (masked accumulate, same
-    # pattern as the replicated path but over n*C tokens, not T)
     w_up = jnp.asarray(params["w_up"])
     b_up = jnp.asarray(params["b_up"])
     w_down = jnp.asarray(params["w_down"])
     b_down = jnp.asarray(params["b_down"])
 
-    def one_expert(e, acc):
-        h = jax.nn.gelu(toks @ w_up[e] + b_up[e])
-        y = h @ w_down[e] + b_down[e]
-        m = (te == e).astype(toks.dtype)[:, None]
-        return acc + y * m
+    gates, ids = _route_topk(params, x, k)
+    out = jnp.zeros_like(x)
+    for j in range(k):  # k static dispatch rounds, one per routing slot
+        expert = ids[..., j]                      # global expert id [T]
+        gate = gates[..., j]                      # [T]
+        # position of each token within ITS EXPERT's send buffer: running
+        # count of earlier tokens routed to the same expert (stable
+        # priority by position, the Switch convention); >= capacity drops
+        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+        pos = (jnp.cumsum(onehot, axis=0) - onehot)[
+            jnp.arange(t_local), expert
+        ]
+        keep = pos < capacity
+        # dropped tokens target the out-of-bounds slot `capacity` so
+        # mode="drop" discards them (a clipped in-bounds index would
+        # clobber a kept token's slot)
+        safe = jnp.where(keep, pos, capacity)
+        send = jnp.zeros((n_experts, capacity, d), x.dtype)
+        send = send.at[expert, safe].set(x, mode="drop")
+        valid = jnp.zeros((n_experts, capacity), x.dtype)
+        valid = valid.at[expert, safe].set(
+            jnp.ones_like(gate), mode="drop"
+        )
 
-    out_toks = jax.lax.fori_loop(
-        0, n_local, one_expert, jnp.zeros_like(toks)
-    )
+        # exchange: destination chip = expert // n_local, positional
+        recv = jax.lax.all_to_all(
+            send.reshape(n, n_local * capacity, d),
+            axis_name, 0, 0, tiled=False,
+        ).reshape(n, n_local, capacity, d)
+        recv_v = jax.lax.all_to_all(
+            valid.reshape(n, n_local * capacity),
+            axis_name, 0, 0, tiled=False,
+        ).reshape(n, n_local, capacity)
 
-    # return trip: results back to the owning chips, then gather each
-    # token's result from its (dst, pos) slot
-    back = jax.lax.all_to_all(
-        out_toks.reshape(n, capacity, d), axis_name, 0, 0, tiled=False
-    )
-    result = back[dst, jnp.where(keep, pos, 0)]
-    return jnp.where(keep[:, None], result * gate[:, None], 0.0)
+        # local experts over their received slabs ([n_src * C, D] each)
+        def one_expert(e, acc):
+            te = recv[:, e].reshape(n * capacity, d)
+            h = jax.nn.gelu(te @ w_up[e] + b_up[e])
+            y = (h @ w_down[e] + b_down[e]).reshape(n, capacity, d)
+            y = y * recv_v[:, e][..., None]  # empty slots: no bias leak
+            return acc.at[:, e].set(y)
+
+        out_buf = jax.lax.fori_loop(
+            0, n_local, one_expert, jnp.zeros_like(recv)
+        )
+
+        # return trip, then gather each token's result from its
+        # (expert, pos) slot
+        back = jax.lax.all_to_all(
+            out_buf.reshape(n, n_local * capacity, d),
+            axis_name, 0, 0, tiled=False,
+        ).reshape(n_experts, capacity, d)
+        res = back[expert, jnp.where(keep, pos, 0)]
+        out = out + jnp.where(keep[:, None], res * gate[:, None], 0.0)
+    return out
 
 
 @functools.lru_cache(maxsize=32)
-def _dispatch_program(mesh, capacity: int, axis_name: str):
+def _dispatch_program(mesh, capacity: int, axis_name: str, k: int):
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -280,7 +294,7 @@ def _dispatch_program(mesh, capacity: int, axis_name: str):
     return jax.jit(
         jax.shard_map(
             functools.partial(
-                _dispatch_body, capacity=capacity, axis_name=axis_name
+                _dispatch_body, capacity=capacity, axis_name=axis_name, k=k
             ),
             mesh=mesh,
             in_specs=(expert_sharded, P(axis_name)),
@@ -296,14 +310,19 @@ def moe_dispatch_apply(
     mesh=None,
     axis_name: str = EXPERT_AXIS,
     capacity_factor: float = 1.25,
+    k: int = 1,
 ):
     """All-to-all routed MoE over ``[B, L, D]`` (Switch-Transformer data
-    path): tokens sharded over ``axis_name``, routed to their expert's chip
-    with ``capacity = ceil(cf * T_local / n)`` slots per (src, dst) pair,
-    processed, and returned. Tokens beyond a destination's capacity are
-    DROPPED (output zero) — choose ``capacity_factor`` >= n for exactness
-    under any routing, or keep the default and accept the standard Switch
-    behavior. Use :func:`moe_apply` for the exact masked-compute variant.
+    path): tokens sharded over ``axis_name``, routed to their experts'
+    chips with ``capacity = ceil(cf * T_local / E)`` slots PER
+    (source shard, expert) per round, processed, and returned; ``k``
+    routing slots dispatch in ``k`` rounds whose gate-scaled results
+    sum. Tokens beyond
+    an expert's capacity are DROPPED (contribute zero) — choose
+    ``capacity_factor`` >= E/k for exactness under any routing, or keep
+    the default and accept the standard Switch behavior. Fully
+    differentiable (grads match the dense oracle wherever no token
+    dropped). Use :func:`moe_apply` for the exact masked-compute variant.
     """
     import jax
     import jax.numpy as jnp
@@ -319,6 +338,8 @@ def moe_dispatch_apply(
             f"n_experts={n_experts} must divide by the {axis_name!r} axis "
             f"size {n}"
         )
+    if not 1 <= k <= n_experts:
+        raise ValueError(f"k={k} must be in [1, {n_experts}]")
     b, l, d = x.shape
     t = b * l
     if t % n:
@@ -327,21 +348,27 @@ def moe_dispatch_apply(
             f"axis size {n}"
         )
     t_local = t // n
-    capacity = int(np.ceil(capacity_factor * t_local / n))
+    # capacity is PER ROUND (each of the k rounds dispatches every token
+    # exactly once, so expected per-expert load per round is T_local / E
+    # regardless of k); total slots across rounds stay at the Switch
+    # convention cf * k * T_local / E
+    capacity = int(np.ceil(capacity_factor * t_local / n_experts))
     flat = jnp.reshape(jnp.asarray(x), (t, d))
-    out = _dispatch_program(mesh, capacity, axis_name)(params, flat)
+    out = _dispatch_program(mesh, capacity, axis_name, k)(params, flat)
     return jnp.reshape(out, (b, l, d))
 
 
-def moe_load_balance_loss(params: Params, x):
+def moe_load_balance_loss(params: Params, x, k: int = 1):
     """Switch-Transformer auxiliary load-balancing loss:
-    ``E * sum_e f_e * p_e`` where ``f_e`` is the fraction of tokens routed
-    to expert ``e`` (top-1) and ``p_e`` the mean router probability. Equals
-    1.0 under perfectly uniform routing; add a small multiple to the task
-    loss to keep experts utilized (dropped-token rates down under the
-    capacity dispatch). Differentiable through ``p_e`` (the ``f_e`` factor
-    carries no gradient, per the standard formulation). Recomputes the
-    router projection — one [T, D] x [D, E] matmul, negligible next to the
+    ``E * sum_e f_e * p_e`` where ``f_e`` is the fraction of ROUTING SLOTS
+    assigned to expert ``e`` (mean one-hot over all ``k`` top-k slots, so
+    the loss reflects actual assignment under top-k routing) and ``p_e``
+    the mean router probability. Equals 1.0 under perfectly uniform
+    routing; add a small multiple to the task loss to keep experts
+    utilized (dropped-token rates down under the capacity dispatch).
+    Differentiable through ``p_e`` (the ``f_e`` factor carries no
+    gradient, per the standard formulation). Recomputes the router
+    projection — one [T, D] x [D, E] matmul, negligible next to the
     expert FFNs — so it composes with any apply path without changing
     their signatures."""
     import jax
@@ -350,9 +377,9 @@ def moe_load_balance_loss(params: Params, x):
     n_experts = params["w_up"].shape[0]
     logits = x @ jnp.asarray(params["router"])
     probs = jax.nn.softmax(logits, axis=-1).reshape(-1, n_experts)
-    chosen = jnp.argmax(probs, axis=-1)
+    _, ids = jax.lax.top_k(probs, k)              # [T, k]
     f = jnp.mean(
-        jax.nn.one_hot(chosen, n_experts, dtype=probs.dtype), axis=0
+        jax.nn.one_hot(ids, n_experts, dtype=probs.dtype), axis=(0, 1)
     )
     p = jnp.mean(probs, axis=0)
     return n_experts * jnp.sum(jax.lax.stop_gradient(f) * p)

@@ -498,3 +498,160 @@ class TestFitPipelined:
         toks = nprng.integers(0, 20, size=(8, 9)).astype(np.int32)
         with pytest.raises(ValueError, match="pp=4"):
             m.fit_pipelined(toks, make_mesh({"pp": 4}), steps=1)
+
+
+class TestMoETraining:
+    """Grads through BOTH expert data paths vs the dense oracle, and
+    routed-LM training on the ep mesh (VERDICT r2 #4)."""
+
+    def _grad_setup(self, nprng, n_experts=8):
+        from tensorframes_tpu.parallel import init_moe
+
+        params = init_moe(0, d_model=8, d_ff=16, n_experts=n_experts)
+        x = jnp.asarray(nprng.normal(size=(2, 8, 8)).astype(np.float32))
+        return params, x
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_moe_apply_grads_match_dense_oracle(self, nprng, k):
+        from tensorframes_tpu.parallel import init_moe, moe_apply, moe_ffn
+
+        params, x = self._grad_setup(nprng)
+        mesh = make_mesh({"ep": 4})
+
+        def loss_sharded(p, x):
+            return (moe_apply(p, x, mesh=mesh, k=k) ** 2).sum()
+
+        def loss_dense(p, x):
+            return (moe_ffn(p, x, k=k) ** 2).sum()
+
+        gs = jax.grad(loss_sharded, argnums=(0, 1))(params, x)
+        gd = jax.grad(loss_dense, argnums=(0, 1))(params, x)
+        for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(gd)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_dispatch_grads_match_dense_oracle(self, nprng, k):
+        from tensorframes_tpu.parallel import (
+            init_moe,
+            moe_dispatch_apply,
+            moe_ffn,
+        )
+
+        params, x = self._grad_setup(nprng)
+        mesh = make_mesh({"ep": 4})
+
+        # generous capacity: nothing drops, so grads must match exactly
+        def loss_dispatch(p, x):
+            return (
+                moe_dispatch_apply(
+                    p, x, mesh=mesh, capacity_factor=16.0, k=k
+                )
+                ** 2
+            ).sum()
+
+        def loss_dense(p, x):
+            return (moe_ffn(p, x, k=k) ** 2).sum()
+
+        gs = jax.grad(loss_dispatch, argnums=(0, 1))(params, x)
+        gd = jax.grad(loss_dense, argnums=(0, 1))(params, x)
+        for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(gd)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+    @pytest.mark.parametrize("k", [2, 3])
+    def test_topk_dispatch_matches_oracle(self, nprng, k):
+        from tensorframes_tpu.parallel import (
+            init_moe,
+            moe_dispatch_apply,
+            moe_ffn,
+        )
+
+        params, x = self._grad_setup(nprng)
+        mesh = make_mesh({"ep": 4})
+        got = moe_dispatch_apply(
+            params, x, mesh=mesh, capacity_factor=16.0, k=k
+        )
+        want = moe_ffn(params, x, k=k)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_per_expert_capacity_isolates_experts(self, nprng):
+        # Discriminating setup for PER-EXPERT capacity (the Switch
+        # convention) vs a shared per-(src, dst-chip) buffer: from ONE
+        # source chip, route token 0 -> expert 0 and token 1 -> expert 1
+        # (different experts, SAME destination chip), with capacity 1 per
+        # expert. Per-expert buffers keep both; a shared per-chip buffer
+        # of 1 slot would evict token 1. Token 2 overflows expert 0's
+        # buffer and must drop to zero.
+        from tensorframes_tpu.parallel import init_moe, moe_dispatch_apply
+        from tensorframes_tpu.parallel.moe import moe_ffn
+
+        n_experts = 4
+        params = init_moe(1, d_model=4, d_ff=8, n_experts=n_experts)
+        mesh = make_mesh({"ep": 2})  # chip 0: experts {0,1}; chip 1: {2,3}
+        # router: feature i -> expert i, deterministic
+        params["router"] = (20.0 * np.eye(4)).astype(np.float32)
+        x = np.zeros((1, 8, 4), dtype=np.float32)
+        # source chip 0 holds tokens 0..3 (t_local = 4)
+        x[0, 0, 0] = 1.0  # -> expert 0 (dst chip 0)
+        x[0, 1, 1] = 1.0  # -> expert 1 (dst chip 0, own buffer: survives)
+        x[0, 2, 0] = 1.0  # -> expert 0 again (overflows capacity 1)
+        x[0, 3, 2] = 1.0  # -> expert 2 (dst chip 1)
+        # source chip 1: all to expert 3; only the first fits
+        for i in range(4, 8):
+            x[0, i, 3] = 1.0
+        # cf=1.0, t_local=4, E=4 -> capacity 1 per (source, expert)
+        out = moe_dispatch_apply(
+            params, jnp.asarray(x), mesh=mesh, capacity_factor=1.0, k=1
+        )
+        dense = moe_ffn(params, jnp.asarray(x), k=1)
+        out, dense = np.asarray(out), np.asarray(dense)
+        for kept in (0, 1, 3, 4):
+            np.testing.assert_allclose(
+                out[0, kept], dense[0, kept], rtol=1e-5,
+                err_msg=f"token {kept} should have been processed",
+            )
+        for dropped in (2, 5, 6, 7):
+            np.testing.assert_allclose(
+                out[0, dropped], 0.0, atol=1e-7,
+                err_msg=f"token {dropped} should have been dropped",
+            )
+
+    def test_aux_loss_reflects_topk_assignment(self, nprng):
+        from tensorframes_tpu.parallel import init_moe
+        from tensorframes_tpu.parallel.moe import moe_load_balance_loss
+
+        # router that always picks experts {0, 1} as top-2
+        n_experts = 4
+        params = init_moe(0, d_model=4, d_ff=8, n_experts=n_experts)
+        router = np.zeros((4, n_experts), dtype=np.float32)
+        router[:, 0] = 5.0
+        router[:, 1] = 4.0
+        params["router"] = router
+        x = jnp.asarray(nprng.normal(size=(1, 16, 4)).astype(np.float32))
+        l1 = float(moe_load_balance_loss(params, x, k=1))
+        l2 = float(moe_load_balance_loss(params, x, k=2))
+        # top-1 sees all mass on expert 0 (f = [1,0,0,0]); top-2 splits
+        # slots between experts 0 and 1 (f = [.5,.5,0,0]) — the aux loss
+        # must see the difference
+        assert l2 < l1
+
+    @pytest.mark.parametrize("impl", ["masked", "dispatch"])
+    def test_routed_lm_trains_on_ep_mesh(self, nprng, impl):
+        from tensorframes_tpu.models import TransformerLM
+
+        toks = nprng.integers(0, 30, size=(4, 9)).astype(np.int32)
+        m = TransformerLM.init(
+            0, vocab=30, d_model=8, n_heads=2, n_layers=2, max_len=16,
+            moe_experts=8,
+        )
+        losses = m.fit(
+            toks, steps=6, lr=0.3, mesh=make_mesh({"ep": 4}),
+            moe_aux_weight=1e-2, moe_top_k=2, moe_impl=impl,
+        )
+        assert losses[-1] < losses[0], losses
+        assert all(np.isfinite(losses))
