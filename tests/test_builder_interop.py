@@ -114,3 +114,72 @@ def test_spark_gated():
 
         with pytest.raises(ImportError, match="pyspark"):
             from_spark(None)
+
+
+class TestArrowBatchMapper:
+    """Partition streaming (mapInArrow contract): the executor-side
+    function consumes an iterator of RecordBatches and yields result
+    batches — tested against that exact contract (what Spark executes),
+    no cluster needed. Reference anchor: compute goes to the partitions
+    (DebugRowOps.scala:377-391)."""
+
+    def _batches(self, n=10, per=4):
+        import pyarrow as pa
+
+        out = []
+        for lo in range(0, n, per):
+            rows = min(per, n - lo)
+            out.append(
+                pa.RecordBatch.from_pydict(
+                    {"x": [float(lo + i) for i in range(rows)]}
+                )
+            )
+        return out
+
+    def test_streams_partition_batches(self):
+        import pyarrow as pa
+
+        from tensorframes_tpu.interop.spark import arrow_batch_mapper
+
+        fn = arrow_batch_mapper(lambda x: {"y": x * 2.0 + 1.0})
+        got = list(fn(iter(self._batches())))
+        assert all(isinstance(b, pa.RecordBatch) for b in got)
+        table = pa.Table.from_batches(got)
+        ys = table.column("y").to_pylist()
+        xs = table.column("x").to_pylist()
+        assert ys == [x * 2.0 + 1.0 for x in xs]
+        assert xs == [float(i) for i in range(10)]
+
+    def test_trim_drops_inputs(self):
+        import pyarrow as pa
+
+        from tensorframes_tpu.interop.spark import arrow_batch_mapper
+
+        fn = arrow_batch_mapper(lambda x: {"y": x + 1.0}, trim=True)
+        table = pa.Table.from_batches(list(fn(iter(self._batches()))))
+        assert table.column_names == ["y"]
+
+    def test_batch_rechunking(self):
+        import pyarrow as pa
+
+        from tensorframes_tpu.interop.spark import arrow_batch_mapper
+
+        fn = arrow_batch_mapper(lambda x: {"y": x + 1.0}, batch_rows=2)
+        got = list(fn(iter(self._batches(n=8, per=8))))
+        assert all(b.num_rows <= 2 for b in got)
+        assert sum(b.num_rows for b in got) == 8
+
+    def test_no_driver_materialization(self):
+        # the mapper holds no state across batches: feeding a generator
+        # (not a list) works and each batch is processed independently
+        import pyarrow as pa
+
+        from tensorframes_tpu.interop.spark import arrow_batch_mapper
+
+        def gen():
+            for b in self._batches(n=6, per=3):
+                yield b
+
+        fn = arrow_batch_mapper(lambda x: {"y": x - 1.0})
+        table = pa.Table.from_batches(list(fn(gen())))
+        assert table.num_rows == 6
