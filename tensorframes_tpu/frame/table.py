@@ -434,7 +434,12 @@ class TensorFrame:
         this frees the device buffers for all of them; the next engine op
         re-transfers on demand. Host data is unaffected. Device-resident
         result columns are pulled to the host first so their data survives
-        the release."""
+        the release. THIS frame's multihost registry of globally-sharded
+        arrays (``parallel.multihost``) is dropped too (its data survives,
+        as this process's rows, via the same host pull) — but the
+        registry is per-frame: frames derived by chained multihost ops
+        hold their own references, so to fully free a chain's device
+        arrays, unpersist (or drop) each frame in it."""
         self._force()
         for cd in self._columns.values():
             if cd.dense is not None and _is_device_array(cd.dense):
@@ -442,6 +447,7 @@ class TensorFrame:
                 cd._host_arr = None
             cd._device_arr = None
             cd._sharded_cache = None
+        self._mh_global = None
         return self
 
     def slice_rows(self, lo: int, hi: int) -> "TensorFrame":

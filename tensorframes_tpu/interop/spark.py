@@ -91,9 +91,20 @@ def arrow_batch_mapper(
     accelerator the executor has), and streams result batches back —
     the driver never materializes the table.
 
+    The iterator Spark hands this function covers exactly ONE partition,
+    in row order — so the batches are concatenated and the program runs
+    ONCE over the whole partition. Cross-row block ops (means, softmaxes,
+    anything whose result depends on which rows share a block) therefore
+    see the partition, not Spark's arbitrary Arrow chunking
+    (``spark.sql.execution.arrow.maxRecordsPerBatch`` would otherwise leak
+    into results). This matches the reference, which materializes each
+    partition as one tensor per column before the session runs
+    (``TFDataOps.scala:27-59``); like the reference, the whole partition
+    is resident during the call — size partitions accordingly.
+
     The returned function depends only on pyarrow + this package, so it
     runs under plain pyspark workers; ``batch_rows`` > 0 re-chunks output
-    batches (0 = one batch per input batch). Testable without a Spark
+    batches (0 = pyarrow's default chunking). Testable without a Spark
     cluster by feeding it RecordBatch iterators — which is exactly the
     contract Spark executes.
 
@@ -108,22 +119,26 @@ def arrow_batch_mapper(
     def fn(batches):
         import pyarrow as pa
 
-        for batch in batches:
-            table = pa.Table.from_batches([batch])
-            df = from_arrow(table)
-            out = engine.map_blocks(
-                fetches,
-                df,
-                trim=trim,
-                feed_dict=feed_dict,
-                decoders=decoders,
-                constants=constants,
-            )
-            result = to_arrow(out)
-            if batch_rows > 0:
-                yield from result.to_batches(max_chunksize=batch_rows)
-            else:
-                yield from result.to_batches()
+        batches = list(batches)
+        if not batches:
+            return
+        table = pa.Table.from_batches(batches)
+        if table.num_rows == 0:
+            return
+        df = from_arrow(table)
+        out = engine.map_blocks(
+            fetches,
+            df,
+            trim=trim,
+            feed_dict=feed_dict,
+            decoders=decoders,
+            constants=constants,
+        )
+        result = to_arrow(out)
+        if batch_rows > 0:
+            yield from result.to_batches(max_chunksize=batch_rows)
+        else:
+            yield from result.to_batches()
 
     return fn
 

@@ -144,24 +144,122 @@ def sync_global(x):
 # ---------------------------------------------------------------------------
 
 
-def _global_block_feed(local_df, binding, mesh):
-    """Assemble the globally-sharded feed from this process's local frame:
-    every process contributes its rows via ``global_batch`` — the analog of
-    the reference's per-executor partitions, except no driver ever sees the
-    whole table."""
-    feed = {}
+def _mh_registry(df) -> dict:
+    """The frame's registry of globally-sharded device arrays, one per
+    column: ``{col: (mesh, jax.Array)}``. Frames are immutable, so a cached
+    global assembly of a column stays valid for the frame's lifetime."""
+    reg = getattr(df, "_mh_global", None)
+    if reg is None:
+        reg = {}
+        df._mh_global = reg
+    return reg
+
+
+def _global_feed_col(local_df, col, mesh):
+    """The globally-sharded device feed for one column, memoized so that
+    chained multihost ops (and repeated passes over the same frame) reuse
+    the sharded array instead of re-assembling it from host rows — the
+    multi-process analog of the local engine's device residency
+    (single-chip results chain in HBM without host round-trips; here the
+    global result chains in the fleet's HBM without ever touching a host).
+    The reference re-marshals rows through the JVM on every Session.run
+    (``TFDataOps.scala:27-59``); neither plane here does.
+
+    Two cache levels: the frame-level ``_mh_global`` registry (a lazy
+    multihost result's own fetch arrays — their column storage doesn't
+    exist until the thunk runs), then the column-level ``_sharded_cache``
+    on ``_ColumnData`` — shared with every frame aliasing the column and
+    released by ``unpersist_device`` on any of them. Caching honors the
+    same ``device_cache_bytes`` budget as the single-process sharded feed
+    (``distributed.py``): a column over budget is assembled transiently
+    and freed after the op, so HBM use stays bounded."""
+    from ..utils import get_config
+
+    reg = getattr(local_df, "_mh_global", None)
+    if reg:
+        hit = reg.get(col)
+        if hit is not None and hit[0] == mesh:
+            return hit[1]
+    cd = local_df.column_data(col)
+    local_df.column_block(col)  # dense check (raises for ragged/binary)
+    host = cd.host()
+    if host.nbytes > get_config().device_cache_bytes:
+        return global_batch(host, mesh)  # transient: over budget
+    cache = cd._sharded_cache
+    if cache is None:
+        cache = cd._sharded_cache = {}
+    key = ("mh_global", mesh)
+    arr = cache.get(key)
+    if arr is None:
+        arr = global_batch(host, mesh)
+        cache[key] = arr
+    return arr
+
+
+def _lazy_mh_result(res, g, local_df, mesh, out_specs, block_output, feed, binding):
+    """Build the lazy local result frame for a multihost map: the global
+    result arrays stay sharded over the mesh (registered for reuse by the
+    next multihost op); this process's host rows materialize only if the
+    frame is actually read. Input columns alias the parent's storage, same
+    as the single-process engine."""
+    from ..engine.ops import _fetch_column_info
+    from ..frame import TensorFrame
+    from ..frame.table import _ColumnData
+    from ..schema import FrameInfo
+    from ..utils import get_config
+
+    fetch_names = list(g.fetch_names)
+    result_info = FrameInfo(
+        [
+            _fetch_column_info(n, out_specs[n], block_output=block_output)
+            for n in fetch_names
+        ]
+        + list(local_df.schema)
+    )
+
+    def thunk():
+        cols = {
+            n: _ColumnData(dense=_local_rows_of(res[n])) for n in fetch_names
+        }
+        for c in local_df.schema:
+            cols[c.name] = local_df.column_data(c.name)
+        return TensorFrame(
+            cols, result_info, num_partitions=local_df.num_partitions
+        )
+
+    out = TensorFrame(
+        {}, result_info, num_partitions=local_df.num_partitions, _thunk=thunk
+    )
+    reg = _mh_registry(out)
+    for n in fetch_names:
+        reg[n] = (mesh, res[n])
+    # every parent column passes through, so keep a chained op on ANY of
+    # them lazy: propagate the parent's registry (its fetch arrays), and
+    # reference this pass's input feeds when they fit the cache budget
+    # (over-budget feeds were transient — pinning them here would defeat
+    # the HBM bound). These are refs to arrays the _ColumnData cache
+    # already holds, not extra copies; release is per-frame, see
+    # ``unpersist_device``.
+    budget = get_config().device_cache_bytes
     for ph, col in binding.items():
-        feed[ph] = global_batch(local_df.column_block(col), mesh)
-    return feed
+        if feed[ph].nbytes <= budget:
+            reg.setdefault(col, (mesh, feed[ph]))
+    parent_reg = getattr(local_df, "_mh_global", None)
+    if parent_reg:
+        for col, entry in parent_reg.items():
+            reg.setdefault(col, entry)
+    return out
 
 
 def map_blocks(fetches, local_df, mesh, feed_dict=None):
     """Multi-host ``map_blocks``: ``local_df`` holds THIS process's rows;
     all processes call with the same program and their own shard. Returns
-    a local frame of this process's result rows (fetch columns + inputs).
-    Eager (the cross-process collective assembly happens now), unlike the
-    single-process lazy engine — multi-host programs are SPMD, so laziness
-    would only defer a rendezvous every process must reach anyway."""
+    a lazy local frame of this process's result rows (fetch columns +
+    inputs). The collective program dispatches NOW (multi-host programs
+    are SPMD — every process must reach the rendezvous), but the result
+    stays sharded over the fleet's devices: chained multihost ops feed it
+    straight back without any host round-trip, and this process's host
+    rows materialize only if the frame is actually read."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -171,7 +269,6 @@ def map_blocks(fetches, local_df, mesh, feed_dict=None):
         check_output_collisions,
         validate_map_inputs,
     )
-    from ..frame import TensorFrame
     from ..schema import Unknown
     from .distributed import _cached_program
     from .mesh import DATA_AXIS
@@ -194,7 +291,10 @@ def map_blocks(fetches, local_df, mesh, feed_dict=None):
                 f"keep the leading row dimension (use reduce_blocks)"
             )
     check_output_collisions(out_specs, local_df.schema)
-    feed = _global_block_feed(local_df, binding, mesh)
+    feed = {
+        ph: _global_feed_col(local_df, col, mesh)
+        for ph, col in binding.items()
+    }
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     prog = _cached_program(
         g,
@@ -204,13 +304,9 @@ def map_blocks(fetches, local_df, mesh, feed_dict=None):
         ),
     )
     res = prog(feed)
-    cols = {}
-    for name in g.fetch_names:
-        cols[name] = _local_rows_of(res[name])
-    out = dict(cols)
-    for c in local_df.schema:
-        out[c.name] = local_df.column_data(c.name).host()
-    return TensorFrame.from_columns(out)
+    return _lazy_mh_result(
+        res, g, local_df, mesh, out_specs, True, feed, binding
+    )
 
 
 def _local_rows_of(arr) -> np.ndarray:
@@ -249,7 +345,7 @@ def reduce_blocks(fetches, local_df, mesh):
     binding = validate_reduce_block_graph(g, local_df.schema)
     _ensure_precision(g, local_df.schema)
     feed = {
-        f"{f}_input": global_batch(local_df.column_block(col), mesh)
+        f"{f}_input": _global_feed_col(local_df, col, mesh)
         for f, col in binding.items()
     }
     from .distributed import _cached_program
@@ -292,8 +388,10 @@ def map_rows(fetches, local_df, mesh, feed_dict=None):
       rendezvous is needed because a row map carries no cross-row
       dataflow.
 
-    Returns a local frame of this process's result rows (fetch columns
-    followed by the input columns), like :func:`map_blocks`.
+    Returns a lazy local frame of this process's result rows (fetch
+    columns followed by the input columns), like :func:`map_blocks`: the
+    global result stays sharded over the mesh for chained multihost ops,
+    host rows materialize only on access.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -303,17 +401,26 @@ def map_rows(fetches, local_df, mesh, feed_dict=None):
         check_output_collisions,
         validate_map_inputs,
     )
-    from ..frame import TensorFrame
     from .distributed import _cached_program
     from .mesh import DATA_AXIS
 
     g = _as_graph(fetches, local_df, cell_inputs=True, feed_dict=feed_dict)
     binding = validate_map_inputs(g, local_df.schema, block=False)
-    dense = all(
-        local_df.schema[col].scalar_type.name != "binary"
-        and local_df.column_data(col).dense is not None
-        for col in binding.values()
-    )
+    reg = getattr(local_df, "_mh_global", None) or {}
+
+    def _col_is_dense(col):
+        # a column whose global sharded assembly is already registered is
+        # dense by construction — answering from the registry keeps a lazy
+        # chained frame lazy (no thunk force just to inspect storage)
+        hit = reg.get(col)
+        if hit is not None and hit[0] == mesh:
+            return True
+        return (
+            local_df.schema[col].scalar_type.name != "binary"
+            and local_df.column_data(col).dense is not None
+        )
+
+    dense = all(_col_is_dense(col) for col in binding.values())
     if not dense:
         from ..engine import map_rows as local_map_rows
 
@@ -325,7 +432,7 @@ def map_rows(fetches, local_df, mesh, feed_dict=None):
     out_specs = g.analyze(input_shapes, share_lead=False)
     check_output_collisions(out_specs, local_df.schema)
     feed = {
-        ph: global_batch(local_df.column_data(col).host(), mesh)
+        ph: _global_feed_col(local_df, col, mesh)
         for ph, col in binding.items()
     }
     sharding = NamedSharding(mesh, P(DATA_AXIS))
@@ -338,10 +445,9 @@ def map_rows(fetches, local_df, mesh, feed_dict=None):
         ),
     )
     res = prog(feed)
-    cols = {name: _local_rows_of(res[name]) for name in g.fetch_names}
-    for c in local_df.schema:
-        cols[c.name] = local_df.column_data(c.name).host()
-    return TensorFrame.from_columns(cols)
+    return _lazy_mh_result(
+        res, g, local_df, mesh, out_specs, False, feed, binding
+    )
 
 
 def reduce_rows(fetches, local_df, mesh):
@@ -370,20 +476,39 @@ def reduce_rows(fetches, local_df, mesh):
 
     g = _as_graph(fetches, local_df, cell_inputs=True)
     binding = validate_reduce_row_graph(g, local_df.schema)
-    for col in binding.values():
-        local_df.column_block(col, None)
     _ensure_precision(g, local_df.schema)
     fetch_names = list(g.fetch_names)
+    # pre-flight the row count BEFORE assembling the feed, so a bad count
+    # raises the actionable error (global_batch would die on an opaque
+    # sharding mismatch first). The count comes from the frame registry
+    # when the input is a lazy chained result — no host force — else from
+    # the local frame.
     ndev = int(np.prod(list(mesh.shape.values())))
-    n_local = local_df.num_rows
-    if n_local == 0:
-        raise ValueError("reduce_rows on an empty frame")
-    n_global = n_local * process_count()
+    reg = getattr(local_df, "_mh_global", None) or {}
+    hit = next(
+        (
+            reg[c][1]
+            for c in binding.values()
+            if c in reg and reg[c][0] == mesh
+        ),
+        None,
+    )
+    if hit is not None:
+        n_global = int(hit.shape[0])
+    else:
+        n_local = local_df.num_rows
+        if n_local == 0:
+            raise ValueError("reduce_rows on an empty frame")
+        n_global = n_local * process_count()
     if n_global % ndev != 0:
         raise ValueError(
             f"{n_global} global rows do not shard evenly over {ndev} "
             f"devices; pad or trim to a multiple of the device count"
         )
+    feed = {
+        f: _global_feed_col(local_df, col, mesh)
+        for f, col in binding.items()
+    }
 
     def merge(a, b):
         feed = {}
@@ -410,10 +535,6 @@ def reduce_rows(fetches, local_df, mesh):
         # holds the final value
         return {f: out[f][None] for f in fetch_names}
 
-    feed = {
-        f: global_batch(local_df.column_data(col).host(), mesh)
-        for f, col in binding.items()
-    }
     prog = _cached_program(
         g,
         (mesh, "mh_reduce_rows"),

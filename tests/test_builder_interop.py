@@ -124,7 +124,7 @@ class TestArrowBatchMapper:
     (DebugRowOps.scala:377-391)."""
 
     def _batches(self, n=10, per=4):
-        import pyarrow as pa
+        pa = pytest.importorskip("pyarrow")
 
         out = []
         for lo in range(0, n, per):
@@ -137,7 +137,7 @@ class TestArrowBatchMapper:
         return out
 
     def test_streams_partition_batches(self):
-        import pyarrow as pa
+        pa = pytest.importorskip("pyarrow")
 
         from tensorframes_tpu.interop.spark import arrow_batch_mapper
 
@@ -151,7 +151,7 @@ class TestArrowBatchMapper:
         assert xs == [float(i) for i in range(10)]
 
     def test_trim_drops_inputs(self):
-        import pyarrow as pa
+        pa = pytest.importorskip("pyarrow")
 
         from tensorframes_tpu.interop.spark import arrow_batch_mapper
 
@@ -160,7 +160,7 @@ class TestArrowBatchMapper:
         assert table.column_names == ["y"]
 
     def test_batch_rechunking(self):
-        import pyarrow as pa
+        pa = pytest.importorskip("pyarrow")
 
         from tensorframes_tpu.interop.spark import arrow_batch_mapper
 
@@ -170,9 +170,9 @@ class TestArrowBatchMapper:
         assert sum(b.num_rows for b in got) == 8
 
     def test_no_driver_materialization(self):
-        # the mapper holds no state across batches: feeding a generator
-        # (not a list) works and each batch is processed independently
-        import pyarrow as pa
+        # feeding a generator (not a list) works — the exact iterator
+        # contract Spark executes
+        pa = pytest.importorskip("pyarrow")
 
         from tensorframes_tpu.interop.spark import arrow_batch_mapper
 
@@ -183,3 +183,27 @@ class TestArrowBatchMapper:
         fn = arrow_batch_mapper(lambda x: {"y": x - 1.0})
         table = pa.Table.from_batches(list(fn(gen())))
         assert table.num_rows == 6
+
+    def test_block_semantics_independent_of_arrow_chunking(self):
+        # the iterator covers one partition: a cross-row block op must see
+        # the whole partition, not Spark's arbitrary Arrow batch size
+        # (maxRecordsPerBatch must not leak into results)
+        pa = pytest.importorskip("pyarrow")
+
+        from tensorframes_tpu.interop.spark import arrow_batch_mapper
+
+        fn = arrow_batch_mapper(lambda x: {"y": x - x.mean()})
+        chunked = pa.Table.from_batches(
+            list(fn(iter(self._batches(n=8, per=3))))
+        )
+        whole = pa.Table.from_batches(
+            list(fn(iter(self._batches(n=8, per=8))))
+        )
+        assert chunked.column("y").to_pylist() == whole.column("y").to_pylist()
+
+    def test_empty_partition_yields_nothing(self):
+        pytest.importorskip("pyarrow")
+        from tensorframes_tpu.interop.spark import arrow_batch_mapper
+
+        fn = arrow_batch_mapper(lambda x: {"y": x + 1.0})
+        assert list(fn(iter([]))) == []

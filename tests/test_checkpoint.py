@@ -10,6 +10,10 @@ retention, and trainer resume.
 import numpy as np
 import pytest
 
+pytest.importorskip(
+    "orbax.checkpoint", reason="checkpoint subsystem is an optional extra"
+)
+
 import tensorframes_tpu.parallel as par
 from tensorframes_tpu.utils.checkpoint import (
     CheckpointManager,

@@ -108,6 +108,11 @@ local_df = tft.TensorFrame.from_columns({"x": data[rows]})
 
 # row map over the global mesh: each process feeds 12 rows, gets its 12 back
 mapped = multihost.map_rows(lambda x: {"y": x * 3.0 + 1.0}, local_df, mesh)
+# chained multihost op feeds the registered global result directly — the
+# intermediate frame must stay lazy (its host rows never materialized)
+chained = multihost.map_blocks(lambda y: {"z": y * 2.0}, mapped, mesh)
+lazy_after_chain = bool(mapped.is_lazy)
+local_z = [float(r.z) for r in chained.collect()]
 local_y = [float(r.y) for r in mapped.collect()]
 
 # pairwise row reduce: per-shard fold + all_gather + merge fold, replicated
@@ -136,7 +141,8 @@ ragged_sums = [float(r.s) for r in rr.collect()]
 
 print(f"RESULT{pid} " + json.dumps(
     {"local_y": local_y, "total": float(total), "agg": agg_rows,
-     "ragged": ragged_sums}
+     "ragged": ragged_sums, "local_z": local_z,
+     "lazy_after_chain": lazy_after_chain}
 ), flush=True)
 """
 
@@ -214,6 +220,18 @@ class TestFourProcess:
         for pid in range(4):
             expect = [float(1 + (pid + i) % 3) for i in range(4)]
             assert four_process_result[pid]["ragged"] == expect
+
+    def test_chained_map_stays_device_resident(self, four_process_result):
+        # the chained map_blocks fed map_rows's registered global array:
+        # the intermediate frame stayed lazy across the chain, and the
+        # chained values are the local slice through both programs
+        data = np.arange(48, dtype=np.float32)
+        for pid in range(4):
+            assert four_process_result[pid]["lazy_after_chain"] is True
+            np.testing.assert_allclose(
+                four_process_result[pid]["local_z"],
+                ((data[pid * 12 : (pid + 1) * 12] * 3.0 + 1.0) * 2.0).tolist(),
+            )
 
 
 @pytest.fixture(scope="module")
@@ -318,6 +336,146 @@ class TestMultihostOpValidation:
             multihost.map_blocks(
                 lambda x: {"z": x.sum()}, df, make_mesh({"dp": 8})
             )
+
+    def test_chained_maps_reuse_global_arrays(self):
+        # chained multihost ops feed the registered globally-sharded result
+        # (no host round-trip): the intermediate frame stays lazy and the
+        # second op's feed IS the first op's output array
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.parallel import make_mesh, multihost
+
+        df = tft.TensorFrame.from_columns(
+            {"x": np.arange(16, dtype=np.float32)}
+        )
+        mesh = make_mesh({"dp": 8})
+        m1 = multihost.map_blocks(lambda x: {"y": x * 2.0}, df, mesh)
+        assert m1.is_lazy
+        m2 = multihost.map_blocks(lambda y: {"z": y + 1.0}, m1, mesh)
+        assert m1.is_lazy, "chaining must not materialize the parent"
+        assert m2._mh_global["y"][1] is m1._mh_global["y"][1]
+        rows = m2.collect()
+        np.testing.assert_allclose(
+            [r.z for r in rows], np.arange(16.0) * 2.0 + 1.0
+        )
+        np.testing.assert_allclose(
+            [r.y for r in rows], np.arange(16.0) * 2.0
+        )
+        np.testing.assert_allclose([r.x for r in rows], np.arange(16.0))
+
+    def test_reduce_after_map_keeps_map_lazy(self):
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.parallel import make_mesh, multihost
+
+        df = tft.TensorFrame.from_columns(
+            {"x": np.arange(16, dtype=np.float32)}
+        )
+        mesh = make_mesh({"dp": 8})
+        m1 = multihost.map_blocks(lambda x: {"y": x + 1.0}, df, mesh)
+        total = multihost.reduce_blocks(
+            lambda y_input: {"y": y_input.sum()}, m1, mesh
+        )
+        assert m1.is_lazy, "reduce must feed the registered global array"
+        assert float(total) == float((np.arange(16.0) + 1.0).sum())
+
+    def test_chain_on_input_column_stays_lazy(self):
+        # binding the parent's ORIGINAL input column (not a fetch) must
+        # also avoid forcing the parent: the input feed is referenced in
+        # the child registry when under the cache budget
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.parallel import make_mesh, multihost
+
+        df = tft.TensorFrame.from_columns(
+            {"x": np.arange(16, dtype=np.float32)}
+        )
+        mesh = make_mesh({"dp": 8})
+        m1 = multihost.map_blocks(lambda x: {"y": x * 2.0}, df, mesh)
+        m2 = multihost.map_blocks(lambda x: {"w": x + 5.0}, m1, mesh)
+        assert m1.is_lazy, "chaining on an input column forced the parent"
+        np.testing.assert_allclose(
+            [r.w for r in m2.collect()], np.arange(16.0) + 5.0
+        )
+
+    def test_over_budget_feed_is_transient(self):
+        # columns above device_cache_bytes are assembled per call and not
+        # pinned in any cache (HBM stays bounded, like distributed.py)
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.parallel import make_mesh, multihost
+        from tensorframes_tpu.utils import get_config, set_config
+
+        df = tft.TensorFrame.from_columns(
+            {"x": np.arange(16, dtype=np.float32)}
+        )
+        mesh = make_mesh({"dp": 8})
+        old = get_config().device_cache_bytes
+        set_config(device_cache_bytes=8)  # 16 f32 rows = 64 bytes > budget
+        try:
+            total = multihost.reduce_blocks(
+                lambda x_input: {"x": x_input.sum()}, df, mesh
+            )
+            assert float(total) == float(np.arange(16.0).sum())
+            cd = df.column_data("x")
+            assert not cd._sharded_cache, "over-budget feed was pinned"
+            m1 = multihost.map_blocks(lambda x: {"y": x + 1.0}, df, mesh)
+            assert "x" not in (getattr(m1, "_mh_global", None) or {}), (
+                "over-budget input feed pinned on the result frame"
+            )
+            np.testing.assert_allclose(
+                [r.y for r in m1.collect()], np.arange(16.0) + 1.0
+            )
+        finally:
+            set_config(device_cache_bytes=old)
+
+    def test_reduce_rows_after_map_keeps_map_lazy(self):
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.parallel import make_mesh, multihost
+
+        df = tft.TensorFrame.from_columns(
+            {"x": np.arange(16, dtype=np.float32)}
+        )
+        mesh = make_mesh({"dp": 8})
+        m1 = multihost.map_blocks(lambda x: {"y": x + 2.0}, df, mesh)
+        total = multihost.reduce_rows(
+            lambda y_1, y_2: {"y": y_1 + y_2}, m1, mesh
+        )
+        assert m1.is_lazy, "reduce_rows must feed the registered array"
+        assert float(total) == float((np.arange(16.0) + 2.0).sum())
+
+    def test_unpersist_device_releases_global_registry(self):
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.parallel import make_mesh, multihost
+
+        df = tft.TensorFrame.from_columns(
+            {"x": np.arange(16, dtype=np.float32)}
+        )
+        mesh = make_mesh({"dp": 8})
+        m1 = multihost.map_blocks(lambda x: {"y": x * 2.0}, df, mesh)
+        assert m1._mh_global
+        m1.unpersist_device()
+        assert getattr(m1, "_mh_global", None) is None
+        # data survived the release as host rows; the next multihost op
+        # just re-assembles its feed
+        np.testing.assert_allclose(
+            [r.y for r in m1.collect()], np.arange(16.0) * 2.0
+        )
+        total = multihost.reduce_blocks(
+            lambda y_input: {"y": y_input.sum()}, m1, mesh
+        )
+        assert float(total) == float((np.arange(16.0) * 2.0).sum())
+
+    def test_map_rows_chains_on_mapped_output(self):
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.parallel import make_mesh, multihost
+
+        df = tft.TensorFrame.from_columns(
+            {"x": np.arange(16, dtype=np.float32)}
+        )
+        mesh = make_mesh({"dp": 8})
+        m1 = multihost.map_blocks(lambda x: {"y": x * 3.0}, df, mesh)
+        m2 = multihost.map_rows(lambda y: {"w": y - 1.0}, m1, mesh)
+        assert m1.is_lazy, "row map must answer density from the registry"
+        np.testing.assert_allclose(
+            [r.w for r in m2.collect()], np.arange(16.0) * 3.0 - 1.0
+        )
 
     def test_multi_axis_mesh_dedups_replica_shards(self):
         # P("dp") output on a dp x tp mesh is replicated over tp;
