@@ -29,6 +29,20 @@ from benchmarks.configs import _sync  # readback barrier (advisory
 # block_until_ready on relayed/tunneled PJRT devices — one shared recipe)
 
 
+def _make_qkv(L, B, H, D, dtype):
+    """Shared benchmark inputs: every row (forward, dense, train-step)
+    measures the same distribution and dtype handling."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    shape = (B, H, L, D)
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    mk = lambda: jnp.asarray(
+        rng.normal(size=shape).astype(np.float32)
+    ).astype(dt)
+    return mk(), mk(), mk()
+
+
 def bench_one(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
     import jax
     import jax.numpy as jnp
@@ -38,12 +52,7 @@ def bench_one(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
         flash_attention,
     )
 
-    rng = np.random.default_rng(0)
-    shape = (B, H, L, D)
-    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-    q = jnp.asarray(rng.normal(size=shape).astype(np.float32)).astype(dt)
-    k = jnp.asarray(rng.normal(size=shape).astype(np.float32)).astype(dt)
-    v = jnp.asarray(rng.normal(size=shape).astype(np.float32)).astype(dt)
+    q, k, v = _make_qkv(L, B, H, D, dtype)
 
     # chain the op inside ONE jitted program (output feeds the next query)
     # so per-dispatch link latency amortizes and the chip time dominates
@@ -125,11 +134,55 @@ def bench_one(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
     }
 
 
+def bench_backward(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
+    """Train-step row: fwd + FlashAttention-2 backward (the custom VJP's
+    two pallas kernels), the op long-context TRAINING actually runs.
+    FLOP model: fwd 1x + bwd 2.5x (dq/dk/dv matmuls + softmax tile
+    recompute) of the forward's 4*B*H*L^2*D."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.ops.attention import flash_attention
+
+    q, k, v = _make_qkv(L, B, H, D, dtype)
+
+    def loss(a, b, c):
+        return flash_attention(a, b, c, causal=causal).astype(
+            jnp.float32
+        ).sum()
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    _sync(g(q, k, v)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = g(q, k, v)
+    _sync(out[0])
+    dt_step = (time.perf_counter() - t0) / iters
+    flops = 3.5 * 4.0 * B * H * L * L * D * (0.5 if causal else 1.0)
+    return {
+        "metric": "flash_attention_train_step_ms",
+        "seq_len": L,
+        "batch": B,
+        "heads": H,
+        "head_dim": D,
+        "causal": causal,
+        "dtype": dtype,
+        "fwd_bwd_ms": round(dt_step * 1e3, 3),
+        "tflops": round(flops / dt_step / 1e12, 2),
+        "mfu_pct_of_v5e_peak": round(
+            100.0 * flops / dt_step / _V5E_PEAK_FLOPS[dtype], 1
+        ),
+    }
+
+
 def main():
     lens = [int(a) for a in sys.argv[1:]] or [1024, 2048, 4096, 8192, 16384]
     for L in lens:
         for dtype in ("bfloat16", "float32"):
             print(json.dumps(bench_one(L, dtype=dtype)))
+    for L in lens:
+        if L >= 4096:
+            print(json.dumps(bench_backward(L)))
 
 
 def run_all():
@@ -141,6 +194,9 @@ def run_all():
     # long-context rows where compute dominates the per-call floor
     out.append(bench_one(16384, B=2, dtype="bfloat16"))
     out.append(bench_one(32768, B=1, dtype="bfloat16"))
+    # training rows: the backward pass is pallas too
+    out.append(bench_backward(8192))
+    out.append(bench_backward(16384, B=2))
     return out
 
 

@@ -115,7 +115,9 @@ def _attention(x, block, n_heads, causal, attn_impl, mesh, batch_axis=None):
             q, k, v, mesh=mesh, causal=causal, batch_axis=batch_axis
         )
     elif attn_impl == "ulysses":
-        o = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+        o = ulysses_attention(
+            q, k, v, mesh=mesh, causal=causal, batch_axis=batch_axis
+        )
     elif attn_impl == "flash":
         o = flash_attention(q, k, v, causal=causal)
     else:
@@ -361,12 +363,15 @@ class TransformerLM:
         moe_aux_weight: float = 0.0,
         moe_top_k: int = 1,
         moe_impl: str = "masked",
+        attn_impl: str = "reference",
     ):
         """Jitted SGD on next-token loss. Single chip by default; pass a
         mesh with an ``ep`` axis to train MoE blocks expert-parallel
         (``moe_impl``: "masked" exact compute or "dispatch" Switch
         all-to-all), with ``moe_aux_weight`` adding the load-balancing
-        loss."""
+        loss. ``attn_impl="flash"`` trains through the pallas kernel's
+        custom VJP (long context on one chip without the [L, L] matrix);
+        sequence-parallel training lives in :meth:`fit_sharded`."""
         kw = {}
         if mesh is not None:
             kw["mesh"] = mesh
@@ -376,6 +381,8 @@ class TransformerLM:
             kw["moe_top_k"] = moe_top_k
         if moe_impl != "masked":
             kw["moe_impl"] = moe_impl
+        if attn_impl != "reference":
+            kw["attn_impl"] = attn_impl
         return self._sgd_loop(tokens, steps, lr, loss_kwargs=kw)
 
     def fit_sharded(
@@ -387,10 +394,12 @@ class TransformerLM:
         attn_impl: str = "ring",
     ):
         """One jitted SGD step over a ``dp x sp`` mesh: batch rows sharded
-        over ``dp``, attention sequence-parallel over ``sp`` (ring K/V
-        rotation with ``batch_axis="dp"`` — both axes live in the SAME
+        over ``dp``, attention sequence-parallel over ``sp`` — ``"ring"``
+        (K/V rotation, any head count) or ``"ulysses"`` (two all_to_all
+        transposes + the flash kernel's custom VJP; needs heads divisible
+        by sp), both with ``batch_axis="dp"``. Both axes live in the SAME
         program, so GSPMD inserts the gradient all-reduce over dp around
-        the ring's ppermute hops over sp).
+        the sequence-parallel collectives over sp.
 
         Constraint from the loss shift: the attention runs on ``L - 1``
         positions, so ``tokens.shape[1] - 1`` must divide by the sp axis
@@ -402,12 +411,14 @@ class TransformerLM:
             raise ValueError(
                 f"fit_sharded needs a ('dp','sp') mesh; got {mesh.axis_names}"
             )
-        if attn_impl != "ring":
-            # ulysses/flash lower through pallas, whose JVP rule cannot be
-            # differentiated here; and only the ring path composes with a
-            # sharded batch axis today
+        if attn_impl not in ("ring", "ulysses"):
+            # both sequence-parallel impls train (flash_attention carries a
+            # custom FlashAttention-2 VJP, so ulysses differentiates
+            # through its pallas kernel); plain "flash"/"reference" keep
+            # the sequence resident per chip, which contradicts the sp
+            # sharding this path exists for
             raise ValueError(
-                f"fit_sharded supports attn_impl='ring' only; got "
+                f"fit_sharded supports attn_impl='ring' or 'ulysses'; got "
                 f"{attn_impl!r}"
             )
         b, length = tokens.shape

@@ -70,14 +70,14 @@ def ulysses_attention_sharded(
 
 
 @functools.lru_cache(maxsize=64)
-def _ulysses_program(mesh, causal: bool, axis_name: str):
+def _ulysses_program(mesh, causal: bool, axis_name: str, batch_axis=None):
     from jax.sharding import PartitionSpec as P
 
     # interpret must follow the MESH's devices, not the default backend:
     # the multichip dryrun runs this over virtual CPU devices on a box
     # whose default platform is a TPU
     interpret = mesh.devices.flat[0].platform != "tpu"
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
     return jax.jit(
         jax.shard_map(
             functools.partial(
@@ -91,7 +91,7 @@ def _ulysses_program(mesh, causal: bool, axis_name: str):
             out_specs=spec,
             # the pallas flash kernel does not annotate varying-mesh-axes
             # on its out_shape; every input/output here is uniformly
-            # sp-sharded by construction, so the check adds nothing
+            # sharded by construction, so the check adds nothing
             check_vma=False,
         )
     )
@@ -104,11 +104,15 @@ def ulysses_attention(
     mesh=None,
     causal: bool = False,
     axis_name: str = SEQ_AXIS,
+    batch_axis=None,
 ):
     """Full-array entry point: shards ``[B, H, L, D]`` over the mesh's
     ``axis_name`` axis, re-shards to heads with one collective transpose,
     attends, and shards back. ``L`` and ``H`` must divide by the axis
-    size."""
+    size. ``batch_axis`` additionally shards the batch dim over another
+    mesh axis (dp x sp composition in one program, like the ring — the
+    all_to_all exchanges ride the sp axis only, so the body is
+    batch-agnostic)."""
     mesh = resolve_sp_mesh(mesh, axis_name)
     n = mesh.shape[axis_name]
     check_divisible(
@@ -119,4 +123,13 @@ def ulysses_attention(
             f"head count {q.shape[1]} must divide by the {axis_name} axis "
             f"size {n}; use ring_attention for head counts < the axis size"
         )
-    return _ulysses_program(mesh, causal, axis_name)(q, k, v)
+    if batch_axis is not None:
+        if batch_axis not in mesh.shape:
+            raise ValueError(
+                f"batch_axis {batch_axis!r} is not a mesh axis; mesh has "
+                f"{tuple(mesh.shape)}"
+            )
+        check_divisible(
+            mesh.shape[batch_axis], batch_axis, batch=q.shape[0]
+        )
+    return _ulysses_program(mesh, causal, axis_name, batch_axis)(q, k, v)

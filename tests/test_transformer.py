@@ -109,6 +109,51 @@ class TestFitShardedDpSp:
         with pytest.raises(ValueError, match="sp"):
             lm.fit_sharded(toks, mesh, steps=1)
 
+    def test_ulysses_losses_match_single_device_fit(self):
+        # ulysses trains through the flash kernel's custom VJP: the two
+        # all_to_all transposes and the pallas backward compose under
+        # jax.grad inside the dp x sp program
+        from tensorframes_tpu.parallel import make_mesh
+
+        rng = np.random.default_rng(6)
+        vocab, L, B = 16, 17, 8  # L-1 = 16 divides sp=4; H=4 divides sp=4
+        toks = rng.integers(0, vocab, size=(B, L)).astype(np.int32)
+
+        lm1 = TransformerLM.init(0, vocab, d_model=16, n_heads=4, max_len=L)
+        losses_1 = lm1.fit(toks, steps=4, lr=0.2)
+
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        lm2 = TransformerLM.init(0, vocab, d_model=16, n_heads=4, max_len=L)
+        losses_2 = lm2.fit_sharded(
+            toks, mesh, steps=4, lr=0.2, attn_impl="ulysses"
+        )
+
+        np.testing.assert_allclose(losses_2, losses_1, rtol=1e-4, atol=1e-5)
+
+    def test_single_chip_flash_fit_matches_reference_fit(self):
+        # flash's custom VJP on one chip: same training trajectory as the
+        # dense reference attention (L=128 divides the kernel's tiles)
+        rng = np.random.default_rng(7)
+        vocab, L, B = 16, 129, 2
+        toks = rng.integers(0, vocab, size=(B, L)).astype(np.int32)
+
+        lm1 = TransformerLM.init(0, vocab, d_model=16, n_heads=4, max_len=L)
+        losses_ref = lm1.fit(toks, steps=3, lr=0.2)
+        lm2 = TransformerLM.init(0, vocab, d_model=16, n_heads=4, max_len=L)
+        losses_flash = lm2.fit(toks, steps=3, lr=0.2, attn_impl="flash")
+        np.testing.assert_allclose(
+            losses_flash, losses_ref, rtol=1e-4, atol=1e-5
+        )
+
+    def test_unsupported_impl_rejected(self):
+        from tensorframes_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        lm = TransformerLM.init(0, 16, d_model=16, n_heads=4, max_len=17)
+        toks = np.zeros((8, 17), np.int32)
+        with pytest.raises(ValueError, match="ring.*ulysses"):
+            lm.fit_sharded(toks, mesh, steps=1, attn_impl="reference")
+
 
 class TestMoETransformer:
     """Transformer blocks with a routed MoE MLP (moe_experts=...)."""
