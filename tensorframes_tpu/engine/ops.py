@@ -1234,6 +1234,37 @@ def _group_sort(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple:
     return hit
 
 
+def _segment_flags(neq, n: int) -> np.ndarray:
+    """Host bool segment-start marks from a DEVICE adjacent-inequality
+    vector, without the O(n) readback: one scalar (distinct-boundary
+    count) and one [G-1] index vector cross the link instead of n bools.
+    The host array is reconstructed by scattering True at the starts —
+    grouped aggregation's host<->device traffic becomes O(groups) in the
+    many-rows-per-group regime, which is what makes 10M-row aggregates
+    usable on a tunnel-attached chip. High-cardinality keys (groups ~
+    rows) fall back to the plain bool readback, which is the smaller
+    transfer there."""
+    import jax.numpy as jnp
+
+    g_minus_1 = int(neq.sum())
+    if g_minus_1 > max(n // 8, 1):
+        return np.concatenate([[True], np.asarray(neq)])
+    flags = np.zeros(n, dtype=bool)
+    flags[0] = True
+    if g_minus_1:
+        # round the static nonzero size up to a power of two so a stream
+        # of frames with varying group counts compiles O(log n) programs,
+        # not one per distinct count; fill_value=-1 marks the padding
+        # (a real boundary index can be 0)
+        size = 1 << (g_minus_1 - 1).bit_length()
+        starts = np.asarray(
+            jnp.nonzero(neq, size=size, fill_value=-1)[0]
+        )
+        starts = starts[:g_minus_1] + 1
+        flags[starts] = True
+    return flags
+
+
 def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple:
     """Group-key machinery shared by the local and distributed aggregates.
 
@@ -1292,7 +1323,7 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
         for sk in sorted_keys:
             d = sk[1:] != sk[:-1]
             neq = d if neq is None else (neq | d)
-        flags = np.concatenate([[True], np.asarray(neq)])
+        flags = _segment_flags(neq, n)
         order = order_dev  # device-resident; no host round trip
 
         def emit_keys(ends):
@@ -1325,8 +1356,18 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
         def binary_codes(cells) -> np.ndarray:
             if pd is not None:
                 arr = np.empty(n, dtype=object)
-                arr[:] = [bytes(c) for c in cells]
-                return pd.factorize(arr)[0].astype(np.int64, copy=False)
+                # storage cells are bytes already: direct elementwise
+                # assign (C speed) instead of 10M bytes() calls. The
+                # TypeError fallback covers non-bytes byte-likes
+                # (bytearray, memoryview), which assign fine but are
+                # unhashable inside factorize; genuine factorize failures
+                # (MemoryError etc.) propagate.
+                arr[:] = cells
+                try:
+                    return pd.factorize(arr)[0].astype(np.int64, copy=False)
+                except TypeError:
+                    arr[:] = [bytes(c) for c in cells]
+                    return pd.factorize(arr)[0].astype(np.int64, copy=False)
             # fallback: fixed-width S array (trailing 0x01 sentinel defeats
             # numpy's trailing-NUL stripping) unless one outlier key would
             # balloon the n x max_len buffer, where the O(total bytes)
@@ -1393,21 +1434,20 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
             codes = first_appearance_codes(
                 np.stack(per_col, axis=1), axis=0
             )
+        if n < 2**31:
+            # codes are row indices at most: int32 halves the upload
+            codes = codes.astype(np.int32, copy=False)
         codes_dev = jnp.asarray(codes)
         order_dev = jnp.argsort(codes_dev, stable=True)
         sorted_c = codes_dev[order_dev]
-        flags = np.concatenate(
-            [[True], np.asarray(sorted_c[1:] != sorted_c[:-1])]
-        )
+        flags = _segment_flags(sorted_c[1:] != sorted_c[:-1], n)
         order = order_dev  # device-resident, same as the numeric path
-        order_host_box: List[Optional[np.ndarray]] = [None]
 
         def emit_keys(ends):
-            # key cells live on the host; pull the permutation over once,
-            # lazily, only for this gather
-            if order_host_box[0] is None:
-                order_host_box[0] = np.asarray(order_dev)
-            rows = order_host_box[0][np.asarray(ends)]
+            # gather the G representative row indices ON DEVICE and pull
+            # only those (the full permutation never crosses the link)
+            ends_dev = jnp.asarray(np.asarray(ends))
+            rows = np.asarray(order_dev[ends_dev])
             out = {}
             for k, kd in zip(keys, key_cds):
                 if kd.is_binary:
