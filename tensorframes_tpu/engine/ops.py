@@ -842,14 +842,48 @@ def _map_rows_thunk(
                 ) * spec.scalar_type.np_dtype.itemsize * n
             if est > budget:
                 return None
+            # small rows dispatch in larger chunks: the row cap protects
+            # activation memory for heavy per-row programs, but each
+            # dispatch pays link latency — scale the chunk up until a
+            # call's input+output bytes reach the byte cap (1M scalar
+            # rows: 123 row-capped dispatches -> 1)
+            per_row = max(1, est // n)
+            for ph in binding:
+                cd = col_data[ph]
+                cell = cd.dense.shape[1:]
+                per_row += int(np.prod(cell, initial=1)) * cd.dense.dtype.itemsize
+            fast_chunk = max(
+                chunk, int(get_config().max_bytes_per_device_call // per_row)
+            )
             pieces: Dict[str, List] = {name: [] for name in fetch_names}
             try:
-                for lo in range(0, n, chunk):
-                    hi = min(lo + chunk, n)
+                lo = 0
+                while lo < n:
+                    hi = min(lo + fast_chunk, n)
                     feed = {ph: feeders[ph](lo, hi) for ph in binding}
-                    res = run_bucket(feed, hi - lo)
+                    try:
+                        res = run_bucket(feed, hi - lo)
+                        if fast_chunk > chunk:
+                            # a raised chunk can OOM on activation-heavy
+                            # row programs (the row cap exists for them);
+                            # sync HERE so the failure is catchable and
+                            # the chunk halves toward the cap instead of
+                            # the whole device-resident path being lost.
+                            # Raised chunks are few, so the sync is cheap.
+                            jax.block_until_ready(res)
+                    except Exception as e:
+                        if is_oom(e) and fast_chunk > chunk:
+                            fast_chunk = max(chunk, fast_chunk // 2)
+                            logger.warning(
+                                "map_rows raised chunk exhausted device "
+                                "memory; lowering to %d rows", fast_chunk,
+                            )
+                            del feed
+                            continue
+                        raise
                     for name in fetch_names:
                         pieces[name].append(res[name])
+                    lo = hi
                 cols: Dict[str, _ColumnData] = {}
                 for name in fetch_names:
                     # sync (no transfer) so async failures surface in this

@@ -26,6 +26,14 @@ class Config:
     #: memory stays bounded (conv/attention programs can blow up HBM far
     #: beyond the input bytes). Consumed by engine/ops.py.
     max_rows_per_device_call: int = 8192
+    #: the device-resident ``map_rows`` fast path may RAISE its chunk above
+    #: ``max_rows_per_device_call`` until a chunk's input+output bytes
+    #: reach this bound — tiny rows (scalars, small vectors) dispatch in a
+    #: few large calls instead of hundreds of row-capped ones (each
+    #: dispatch costs link latency; an OOM on a raised chunk halves it
+    #: back toward the row cap without leaving the device-resident path).
+    #: Consumed by engine/ops.py.
+    max_bytes_per_device_call: int = 64 << 20
     #: retries for transient device-runtime failures (UNAVAILABLE /
     #: DEADLINE_EXCEEDED / dropped tunnel); see utils/failures.py. The
     #: reference rode Spark's task retry instead (SURVEY §5).

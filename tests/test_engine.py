@@ -190,6 +190,31 @@ class TestMapRows:
         df2 = tft.map_rows(lambda y: {"s": y.sum()}, df)
         assert [r.s for r in df2.collect()] == [1.0, 5.0, 4.0]
 
+    def test_dense_byte_capped_chunking(self):
+        # tiny rows raise the chunk above the row cap (one dispatch for
+        # the whole column); a tiny byte cap pins it back at the row cap —
+        # results identical either way
+        from tensorframes_tpu.utils import get_config, set_config
+
+        n = 50_000
+        x = np.arange(n, dtype=np.float32)
+
+        def fn(x):
+            return {"y": x * 3.0 + 1.0}
+
+        df = tft.TensorFrame.from_columns({"x": x}).analyze()
+        got = tft.map_rows(fn, df).cache().column_data("y").host()
+        np.testing.assert_allclose(got, x * 3.0 + 1.0)
+
+        old = get_config().max_bytes_per_device_call
+        set_config(max_bytes_per_device_call=1)
+        try:
+            df2 = tft.TensorFrame.from_columns({"x": x}).analyze()
+            got2 = tft.map_rows(fn, df2).cache().column_data("y").host()
+            np.testing.assert_allclose(got2, x * 3.0 + 1.0)
+        finally:
+            set_config(max_bytes_per_device_call=old)
+
     def test_ragged_vector_output(self):
         df = tft.TensorFrame.from_columns({"y": [[1.0], [2.0, 3.0]]}).analyze()
         df2 = tft.map_rows(lambda y: {"d": y * 2}, df)
