@@ -858,22 +858,28 @@ def _map_rows_thunk(
             pieces: Dict[str, List] = {name: [] for name in fetch_names}
             try:
                 lo = 0
+                probe_size = fast_chunk if fast_chunk > chunk else None
                 while lo < n:
                     hi = min(lo + fast_chunk, n)
                     feed = {ph: feeders[ph](lo, hi) for ph in binding}
                     try:
                         res = run_bucket(feed, hi - lo)
-                        if fast_chunk > chunk:
+                        if probe_size == fast_chunk:
                             # a raised chunk can OOM on activation-heavy
-                            # row programs (the row cap exists for them);
-                            # sync HERE so the failure is catchable and
-                            # the chunk halves toward the cap instead of
-                            # the whole device-resident path being lost.
-                            # Raised chunks are few, so the sync is cheap.
+                            # row programs (the row cap exists for them):
+                            # sync the FIRST chunk at each raised size so
+                            # the failure is catchable and the chunk
+                            # halves toward the cap instead of the whole
+                            # device-resident path being lost; later
+                            # same-size chunks dispatch async as usual
                             jax.block_until_ready(res)
+                            probe_size = None
                     except Exception as e:
                         if is_oom(e) and fast_chunk > chunk:
                             fast_chunk = max(chunk, fast_chunk // 2)
+                            probe_size = (
+                                fast_chunk if fast_chunk > chunk else None
+                            )
                             logger.warning(
                                 "map_rows raised chunk exhausted device "
                                 "memory; lowering to %d rows", fast_chunk,

@@ -130,6 +130,32 @@ class TestFitShardedDpSp:
 
         np.testing.assert_allclose(losses_2, losses_1, rtol=1e-4, atol=1e-5)
 
+    def test_fit_tp_matches_single_device_fit(self):
+        # Megatron GSPMD sharding: qkv/up column-parallel, proj/down
+        # row-parallel — same trajectory as the unsharded step
+        from tensorframes_tpu.parallel import make_mesh
+
+        rng = np.random.default_rng(3)
+        toks = rng.integers(0, 16, size=(4, 12)).astype(np.int32)
+        lm1 = TransformerLM.init(0, 16, d_model=16, n_heads=4, max_len=12)
+        ref = lm1.fit(toks, steps=4, lr=0.2)
+        mesh = make_mesh({"dp": 2, "tp": 4})
+        lm2 = TransformerLM.init(0, 16, d_model=16, n_heads=4, max_len=12)
+        got = lm2.fit_tp(toks, mesh, steps=4, lr=0.2)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fit_tp_guards(self):
+        from tensorframes_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"dp": 2, "tp": 4})
+        toks = np.zeros((4, 12), np.int32)
+        lm = TransformerLM.init(0, 16, d_model=18, n_heads=3, max_len=12)
+        with pytest.raises(ValueError, match="head boundaries"):
+            lm.fit_tp(toks, mesh, steps=1)
+        lm2 = TransformerLM.init(0, 16, d_model=16, n_heads=4, max_len=12)
+        with pytest.raises(ValueError, match="batch"):
+            lm2.fit_tp(np.zeros((3, 12), np.int32), mesh, steps=1)
+
     def test_single_chip_flash_fit_matches_reference_fit(self):
         # flash's custom VJP on one chip: same training trajectory as the
         # dense reference attention (L=128 divides the kernel's tiles)
