@@ -115,6 +115,27 @@ def main():
     l2 = lm.fit_sharded(toks2, par.make_mesh({"dp": 2, "sp": 4}, devices=devs), steps=4)
     print(f"dpxsp transformer step: loss {l2[0]:.3f} -> {l2[-1]:.3f}")
 
+    # dp x tp: the TRANSFORMER itself Megatron-sharded (GSPMD annotations;
+    # compare the dp x tp MLP trainer above) — same trajectory as 1 chip
+    lm_tp = TransformerLM.init(0, vocab=32, d_model=16, n_heads=4, max_len=17)
+    l3 = lm_tp.fit_tp(
+        toks2, par.make_mesh({"dp": 2, "tp": 4}, devices=devs), steps=4
+    )
+    print(f"dpxtp transformer step: loss {l3[0]:.3f} -> {l3[-1]:.3f}")
+
+    # pp x dp: 1F1B pipeline training with full-model grads
+    lm_pp = TransformerLM.init(
+        0, vocab=32, d_model=16, n_heads=4, n_layers=4, max_len=17
+    )
+    l4 = lm_pp.fit_pipelined(
+        toks2,
+        par.make_mesh({"pp": 4, "dp": 2}, devices=devs),
+        steps=4,
+        n_micro=2,
+        schedule="1f1b",
+    )
+    print(f"ppxdp transformer step: loss {l4[0]:.3f} -> {l4[-1]:.3f}")
+
 
 if __name__ == "__main__":
     main()

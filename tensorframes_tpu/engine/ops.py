@@ -852,11 +852,18 @@ def _map_rows_thunk(
                 cd = col_data[ph]
                 cell = cd.dense.shape[1:]
                 per_row += int(np.prod(cell, initial=1)) * cd.dense.dtype.itemsize
-            fast_chunk = max(
+            byte_capped = max(
                 chunk, int(get_config().max_bytes_per_device_call // per_row)
             )
-            pieces: Dict[str, List] = {name: [] for name in fetch_names}
-            try:
+
+            def attempt(fast_chunk):
+                """One device-resident pass at the given starting chunk.
+                The first chunk at each raised size syncs as an OOM probe
+                (halving toward the row cap); later same-size chunks
+                dispatch async. A late async OOM (memory pressure grows as
+                result pieces accumulate) surfaces at the terminal sync
+                and is handled by the caller's row-cap retry."""
+                pieces: Dict[str, List] = {name: [] for name in fetch_names}
                 lo = 0
                 probe_size = fast_chunk if fast_chunk > chunk else None
                 while lo < n:
@@ -865,13 +872,6 @@ def _map_rows_thunk(
                     try:
                         res = run_bucket(feed, hi - lo)
                         if probe_size == fast_chunk:
-                            # a raised chunk can OOM on activation-heavy
-                            # row programs (the row cap exists for them):
-                            # sync the FIRST chunk at each raised size so
-                            # the failure is catchable and the chunk
-                            # halves toward the cap instead of the whole
-                            # device-resident path being lost; later
-                            # same-size chunks dispatch async as usual
                             jax.block_until_ready(res)
                             probe_size = None
                     except Exception as e:
@@ -899,7 +899,22 @@ def _map_rows_thunk(
                     )
                     cols[name] = _ColumnData(dense=arr)
                 return cols
-            except Exception:
+
+            try:
+                return attempt(byte_capped)
+            except Exception as e:
+                if is_oom(e) and byte_capped > chunk:
+                    # a LATER raised chunk OOMed past the probe: retry the
+                    # whole pass at the row cap, keeping device residency
+                    logger.warning(
+                        "map_rows byte-capped pass exhausted device "
+                        "memory past the probe; retrying device-resident "
+                        "at the %d-row cap", chunk,
+                    )
+                    try:
+                        return attempt(chunk)
+                    except Exception:
+                        pass
                 logger.warning(
                     "map_rows device-resident path failed; falling back "
                     "to synchronous chunked execution",
