@@ -856,13 +856,17 @@ def _map_rows_thunk(
                 chunk, int(get_config().max_bytes_per_device_call // per_row)
             )
 
+            reached_cap = [byte_capped <= chunk]
+
             def attempt(fast_chunk):
                 """One device-resident pass at the given starting chunk.
                 The first chunk at each raised size syncs as an OOM probe
                 (halving toward the row cap); later same-size chunks
                 dispatch async. A late async OOM (memory pressure grows as
                 result pieces accumulate) surfaces at the terminal sync
-                and is handled by the caller's row-cap retry."""
+                and is handled by the caller's row-cap retry — unless this
+                pass already ran at the cap (``reached_cap``), where a
+                repeat would just OOM again."""
                 pieces: Dict[str, List] = {name: [] for name in fetch_names}
                 lo = 0
                 probe_size = fast_chunk if fast_chunk > chunk else None
@@ -877,6 +881,8 @@ def _map_rows_thunk(
                     except Exception as e:
                         if is_oom(e) and fast_chunk > chunk:
                             fast_chunk = max(chunk, fast_chunk // 2)
+                            if fast_chunk <= chunk:
+                                reached_cap[0] = True
                             probe_size = (
                                 fast_chunk if fast_chunk > chunk else None
                             )
@@ -903,9 +909,11 @@ def _map_rows_thunk(
             try:
                 return attempt(byte_capped)
             except Exception as e:
-                if is_oom(e) and byte_capped > chunk:
+                if is_oom(e) and not reached_cap[0]:
                     # a LATER raised chunk OOMed past the probe: retry the
                     # whole pass at the row cap, keeping device residency
+                    # (skipped when the pass already halved to the cap and
+                    # still OOMed — a repeat would fail the same way)
                     logger.warning(
                         "map_rows byte-capped pass exhausted device "
                         "memory past the probe; retrying device-resident "
