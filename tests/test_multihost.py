@@ -85,6 +85,53 @@ def _free_port():
         s.bind(("localhost", 0))
         return s.getsockname()[1]
 
+def _run_workers(tmp_path_factory, name, source, num_procs, devices_per_proc):
+    """Spawn ``num_procs`` worker processes joined by jax.distributed over
+    Gloo, each with ``devices_per_proc`` virtual CPU devices; returns the
+    (stdout, stderr) pairs after asserting every worker exited cleanly.
+    A worker stuck in the distributed barrier (e.g. its peer died during
+    initialize) must not outlive the fixture holding the port."""
+    d = tmp_path_factory.mktemp(name)
+    worker = d / "worker.py"
+    worker.write_text(source)
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        for i in range(num_procs)
+    ]
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+    return outs
+
+
+def _per_pid_results(outs):
+    results = {}
+    for i, (out, _) in enumerate(outs):
+        line = next(
+            l for l in out.splitlines() if l.startswith(f"RESULT{i} ")
+        )
+        results[i] = json.loads(line[len(f"RESULT{i} "):])
+    return results
+
+
 
 _WORKER4 = r"""
 import json, sys
@@ -149,39 +196,9 @@ print(f"RESULT{pid} " + json.dumps(
 
 @pytest.fixture(scope="module")
 def four_process_result(tmp_path_factory):
-    d = tmp_path_factory.mktemp("mh4")
-    worker = d / "worker4.py"
-    worker.write_text(_WORKER4)
-    port = _free_port()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker), str(i), str(port)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            env=env,
-            text=True,
-        )
-        for i in range(4)
-    ]
-    try:
-        outs = [p.communicate(timeout=300) for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-    results = {}
-    for i, (out, _) in enumerate(outs):
-        line = next(
-            l for l in out.splitlines() if l.startswith(f"RESULT{i} ")
-        )
-        results[i] = json.loads(line[len(f"RESULT{i} "):])
-    return results
+    return _per_pid_results(
+        _run_workers(tmp_path_factory, "mh4", _WORKER4, 4, 2)
+    )
 
 
 class TestFourProcess:
@@ -234,36 +251,67 @@ class TestFourProcess:
             )
 
 
+_WORKER8 = r"""
+import json, sys
+import numpy as np
+from tensorframes_tpu.parallel import multihost
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+multihost.initialize(
+    f"localhost:{port}", num_processes=8, process_id=pid, local_device_count=1
+)
+import jax
+assert jax.process_count() == 8 and len(jax.devices()) == 8
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.parallel import make_mesh
+
+mesh = make_mesh({"dp": 8})
+data = np.arange(64, dtype=np.float32)
+rows = multihost.local_rows(64)
+local_df = tft.TensorFrame.from_columns({"x": data[rows]})
+
+# chained maps stay device-resident across 8 real processes
+m1 = multihost.map_blocks(lambda x: {"y": x * 2.0}, local_df, mesh)
+total = multihost.reduce_blocks(lambda y_input: {"y": y_input.sum()}, m1, mesh)
+lazy = bool(m1.is_lazy)
+local_y = [float(r.y) for r in m1.collect()]
+
+print(f"RESULT{pid} " + json.dumps(
+    {"local_y": local_y, "total": float(total), "lazy": lazy}
+), flush=True)
+"""
+
+
+@pytest.fixture(scope="module")
+def eight_process_result(tmp_path_factory):
+    return _per_pid_results(
+        _run_workers(tmp_path_factory, "mh8", _WORKER8, 8, 1)
+    )
+
+
+class TestEightProcess:
+    """8 processes x 1 device each: one chip per host, the maximal
+    process-to-device ratio — collectives cross a process boundary on
+    EVERY hop."""
+
+    def test_chained_map_reduce_with_device_residency(
+        self, eight_process_result
+    ):
+        data = np.arange(64, dtype=np.float32)
+        for pid in range(8):
+            r = eight_process_result[pid]
+            assert r["lazy"] is True
+            assert r["total"] == float((data * 2.0).sum())
+            np.testing.assert_allclose(
+                r["local_y"],
+                (data[pid * 8 : (pid + 1) * 8] * 2.0).tolist(),
+            )
+
+
 @pytest.fixture(scope="module")
 def two_process_result(tmp_path_factory):
-    d = tmp_path_factory.mktemp("mh")
-    worker = d / "worker.py"
-    worker.write_text(_WORKER)
-    port = _free_port()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker), str(i), str(port)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            env=env,
-            text=True,
-        )
-        for i in range(2)
-    ]
-    try:
-        outs = [p.communicate(timeout=300) for p in procs]
-    finally:
-        # a worker stuck in the distributed barrier (e.g. its peer died
-        # during initialize) must not outlive the fixture holding the port
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, (out, err) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+    outs = _run_workers(tmp_path_factory, "mh", _WORKER, 2, 4)
     line = next(
         l for l in outs[0][0].splitlines() if l.startswith("RESULT ")
     )
