@@ -80,20 +80,21 @@ def main():
     def _check(p):
         return p.sum()
 
-    def _chained(iters):
+    def _chained(iters, graph, frame):
+        # shared forcing discipline for every pipeline mode: accumulate a
+        # device-resident check per pass, ONE host fetch at the end
         acc = None
         for _ in range(iters):
-            sf = map_blocks(g, df)
-            pred_dev = sf.column_data("prediction").device()
-            s = _check(pred_dev)
+            sf = map_blocks(graph, frame)
+            s = _check(sf.column_data("prediction").device())
             acc = s if acc is None else acc + s
-        np.asarray(acc)  # one fetch forces the whole chain
+        np.asarray(acc)
 
-    _chained(3)  # flush: compile _check, absorb the first-sync quantum
+    _chained(3, g, df)  # flush: compile _check, absorb the first-sync quantum
     iters = 100
     with timer.section("pipeline"):
         t0 = time.perf_counter()
-        _chained(iters)
+        _chained(iters, g, df)
         dt_pipeline = (time.perf_counter() - t0) / iters
     rows_per_sec = n_rows / dt_pipeline
 
@@ -112,14 +113,6 @@ def main():
     def score_bf16(features):
         return {"prediction": jnp.argmax(features @ wb + bb, axis=-1)}
 
-    def _chained_b(iters):
-        acc = None
-        for _ in range(iters):
-            sf = map_blocks(score_bf16, dfb)
-            s = _check(sf.column_data("prediction").device())
-            acc = s if acc is None else acc + s
-        np.asarray(acc)
-
     # correctness first, same contract as the f32 path: bf16 inputs lose
     # mantissa, so near-tie argmaxes flip a little more than the MXU's
     # bf16-pass default already does — 98% agreement is the sanity bar
@@ -128,10 +121,10 @@ def main():
     )
     assert (preds_b == ref).mean() > 0.98, "bf16 scoring mismatch"
 
-    _chained_b(3)  # warmup outside the section, like the f32 pipeline
+    _chained(3, score_bf16, dfb)  # warmup outside the section
     with timer.section("bf16_pipeline"):
         t0 = time.perf_counter()
-        _chained_b(iters)
+        _chained(iters, score_bf16, dfb)
         dt_bf16 = (time.perf_counter() - t0) / iters
 
     # -- host-fetch modes --------------------------------------------------

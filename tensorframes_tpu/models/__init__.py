@@ -13,6 +13,7 @@ from .kmeans import kmeans, assign_clusters
 from .transformer import (
     TransformerLM,
     init_transformer,
+    transformer_generate,
     transformer_logits,
     transformer_loss,
 )
@@ -24,6 +25,7 @@ __all__ = [
     "init_cnn",
     "TransformerLM",
     "init_transformer",
+    "transformer_generate",
     "transformer_logits",
     "transformer_loss",
     "MLPClassifier",

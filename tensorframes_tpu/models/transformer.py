@@ -23,6 +23,7 @@ import numpy as np
 __all__ = [
     "init_transformer",
     "transformer_logits",
+    "transformer_generate",
     "transformer_loss",
     "token_nll",
     "TransformerLM",
@@ -241,6 +242,114 @@ def transformer_logits(
     if collect_moe_aux:
         return logits, moe_aux
     return logits
+
+
+def transformer_generate(
+    params: Params,
+    prompt,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    seed: int = 0,
+    moe_top_k: int = 1,
+):
+    """Autoregressive decode with a KV cache, compiled as ONE
+    ``lax.scan`` program: per step the new token's q/k/v are computed,
+    k/v land in a static-shape cache via ``dynamic_update_slice``, and
+    attention reads the cache under a position mask — no recompilation
+    per step, no growing shapes (the XLA-native decode loop; a Python
+    loop re-running :func:`transformer_logits` on the growing sequence
+    recompiles per length and recomputes O(L^2) work per token).
+
+    ``temperature`` 0 = greedy argmax; > 0 samples categorically with a
+    per-step key folded from ``seed``. Returns ``[B, P + max_new_tokens]``
+    int32 (prompt included). ``prompt + max_new_tokens`` must fit
+    ``max_len`` (the positional table)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.moe import moe_ffn
+
+    prompt = jnp.asarray(prompt, dtype=jnp.int32)
+    if prompt.ndim != 2 or prompt.shape[1] < 1:
+        raise ValueError("prompt must be [B, P>=1] token ids")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1; got {max_new_tokens}"
+        )
+    bsz, plen = prompt.shape
+    n_heads = params["n_heads"]
+    embed = jnp.asarray(params["embed"])
+    posemb = jnp.asarray(params["pos"])
+    d_model = embed.shape[1]
+    hd = d_model // n_heads
+    total = plen + max_new_tokens
+    if total > posemb.shape[0]:
+        raise ValueError(
+            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) = "
+            f"{total} exceeds max_len {posemb.shape[0]}"
+        )
+    blocks = params["blocks"]
+    scale = 1.0 / float(np.sqrt(hd))
+    neg = jnp.finfo(jnp.float32).min * 0.7
+
+    k0 = jnp.zeros((len(blocks), bsz, n_heads, total, hd), jnp.float32)
+    v0 = jnp.zeros_like(k0)
+
+    def step(carry, t):
+        kc, vc, prev = carry
+        tok = jnp.where(
+            t < plen,
+            jax.lax.dynamic_index_in_dim(
+                prompt, jnp.minimum(t, plen - 1), axis=1, keepdims=False
+            ),
+            prev,
+        )
+        h = embed[tok] + posemb[t]  # [B, D]
+        for li, block in enumerate(blocks):
+            x = _ln(h, block["ln1"])
+            qkv = x @ jnp.asarray(block["qkv"])
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(bsz, n_heads, hd)
+            kc = jax.lax.dynamic_update_slice(
+                kc,
+                k.reshape(1, bsz, n_heads, 1, hd),
+                (li, 0, 0, t, 0),
+            )
+            vc = jax.lax.dynamic_update_slice(
+                vc,
+                v.reshape(1, bsz, n_heads, 1, hd),
+                (li, 0, 0, t, 0),
+            )
+            s = jnp.einsum("bhd,bhtd->bht", q, kc[li]) * scale
+            s = jnp.where(jnp.arange(total)[None, None, :] <= t, s, neg)
+            att = jnp.einsum(
+                "bht,bhtd->bhd", jax.nn.softmax(s, axis=-1), vc[li]
+            ).reshape(bsz, d_model)
+            h = h + att @ jnp.asarray(block["proj"])
+            hx = _ln(h, block["ln2"])
+            if "moe" in block:
+                h = h + moe_ffn(block["moe"], hx[:, None, :], k=moe_top_k)[
+                    :, 0
+                ]
+            else:
+                h = h + jax.nn.gelu(hx @ jnp.asarray(block["up"])) @ (
+                    jnp.asarray(block["down"])
+                )
+        logits = _ln(h, params["ln_f"]) @ embed.T
+        if temperature and temperature > 0:
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
+            nxt = jax.random.categorical(key, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        return (kc, vc, nxt), nxt
+
+    (_, _, _), outs = jax.lax.scan(
+        step, (k0, v0, prompt[:, 0]), jnp.arange(total - 1)
+    )
+    # step t emits the prediction for position t+1: the generated tokens
+    # are the emissions of steps plen-1 .. total-2
+    return jnp.concatenate([prompt, outs[plen - 1 :].T], axis=1)
 
 
 def token_nll(
@@ -665,6 +774,52 @@ class TransformerLM:
             "n_heads": n_heads,
         }
         return losses
+
+    def generate(
+        self,
+        prompt,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        seed: int = 0,
+        moe_top_k: int = 1,
+    ):
+        """KV-cached autoregressive decode (:func:`transformer_generate`)
+        as one jitted scan program, memoized per (params identity, prompt
+        shape, decode config) in a dict — alternating configs or seeds
+        reuse their compiled programs (greedy decodes ignore ``seed``: it
+        never enters the program); a new fit invalidates all entries
+        because it replaces the params object the keys carry."""
+        import jax
+
+        prompt = np.asarray(prompt, dtype=np.int32)
+        sampled = bool(temperature and temperature > 0)
+        key = (
+            id(self.params),
+            prompt.shape,
+            int(max_new_tokens),
+            float(temperature) if sampled else 0.0,
+            int(seed) if sampled else 0,
+            int(moe_top_k),
+        )
+        cache = getattr(self, "_generate_cache", None)
+        if cache is None:
+            cache = self._generate_cache = {}
+        run = cache.get(key)
+        if run is None:
+            params = self.params
+
+            def impl(p):
+                return transformer_generate(
+                    params,
+                    p,
+                    max_new_tokens,
+                    temperature=temperature,
+                    seed=seed,
+                    moe_top_k=moe_top_k,
+                )
+
+            run = cache[key] = jax.jit(impl)
+        return np.asarray(run(prompt))
 
     def score_frame(
         self,

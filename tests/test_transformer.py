@@ -181,6 +181,87 @@ class TestFitShardedDpSp:
             lm.fit_sharded(toks, mesh, steps=1, attn_impl="reference")
 
 
+class TestGenerate:
+    """KV-cached scan decode vs the naive oracle: re-run the full forward
+    on the growing sequence and argmax the last position."""
+
+    def _naive_greedy(self, lm, prompt, n_new):
+        import jax.numpy as jnp
+
+        from tensorframes_tpu.models import transformer_logits
+
+        toks = np.asarray(prompt, dtype=np.int32)
+        for _ in range(n_new):
+            logits = transformer_logits(lm.params, jnp.asarray(toks))
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            toks = np.concatenate([toks, nxt[:, None].astype(np.int32)], 1)
+        return toks
+
+    def test_greedy_matches_naive_recompute(self):
+        rng = np.random.default_rng(0)
+        lm = TransformerLM.init(3, 32, d_model=16, n_heads=4, max_len=24)
+        prompt = rng.integers(0, 32, size=(2, 5)).astype(np.int32)
+        got = lm.generate(prompt, max_new_tokens=8)
+        want = self._naive_greedy(lm, prompt, 8)
+        np.testing.assert_array_equal(got, want)
+
+    def test_greedy_after_training(self):
+        # decode must read the TRAINED params (cache invalidates on fit)
+        rng = np.random.default_rng(1)
+        lm = TransformerLM.init(0, 16, d_model=16, n_heads=4, max_len=20)
+        prompt = rng.integers(0, 16, size=(1, 4)).astype(np.int32)
+        before = lm.generate(prompt, max_new_tokens=6)
+        toks = rng.integers(0, 16, size=(4, 12)).astype(np.int32)
+        lm.fit(toks, steps=3, lr=0.3)
+        after = lm.generate(prompt, max_new_tokens=6)
+        want = self._naive_greedy(lm, prompt, 6)
+        np.testing.assert_array_equal(after, want)
+        assert before.shape == after.shape
+
+    def test_sampled_decode_deterministic_per_seed(self):
+        rng = np.random.default_rng(2)
+        lm = TransformerLM.init(5, 32, d_model=16, n_heads=4, max_len=20)
+        prompt = rng.integers(0, 32, size=(2, 4)).astype(np.int32)
+        a = lm.generate(prompt, max_new_tokens=8, temperature=1.0, seed=7)
+        b = lm.generate(prompt, max_new_tokens=8, temperature=1.0, seed=7)
+        np.testing.assert_array_equal(a, b)
+        c = lm.generate(prompt, max_new_tokens=8, temperature=1.0, seed=8)
+        assert a.shape == c.shape == (2, 12)
+        assert (a[:, :4] == prompt).all()
+
+    def test_moe_model_greedy_matches_naive(self):
+        rng = np.random.default_rng(3)
+        lm = TransformerLM.init(
+            1, 24, d_model=16, n_heads=4, max_len=20, moe_experts=4
+        )
+        prompt = rng.integers(0, 24, size=(2, 4)).astype(np.int32)
+        got = lm.generate(prompt, max_new_tokens=6)
+        want = self._naive_greedy(lm, prompt, 6)
+        np.testing.assert_array_equal(got, want)
+
+    def test_max_len_guard(self):
+        lm = TransformerLM.init(0, 16, d_model=16, n_heads=4, max_len=10)
+        with pytest.raises(ValueError, match="max_len"):
+            lm.generate(np.zeros((1, 6), np.int32), max_new_tokens=8)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            lm.generate(np.zeros((1, 6), np.int32), max_new_tokens=0)
+
+    def test_compiled_programs_reused_across_configs(self):
+        # alternating seeds/configs must hit the memo dict, and greedy
+        # decodes ignore seed entirely (it never enters the program)
+        rng = np.random.default_rng(4)
+        lm = TransformerLM.init(2, 16, d_model=16, n_heads=4, max_len=20)
+        p = rng.integers(0, 16, size=(1, 4)).astype(np.int32)
+        lm.generate(p, 4, temperature=1.0, seed=1)
+        lm.generate(p, 4, temperature=1.0, seed=2)
+        lm.generate(p, 4, temperature=1.0, seed=1)
+        assert len(lm._generate_cache) == 2  # one per seed, reused after
+        a = lm.generate(p, 4, seed=1)
+        b = lm.generate(p, 4, seed=9)
+        np.testing.assert_array_equal(a, b)
+        assert len(lm._generate_cache) == 3  # greedy adds ONE entry
+
+
 class TestMoETransformer:
     """Transformer blocks with a routed MoE MLP (moe_experts=...)."""
 
