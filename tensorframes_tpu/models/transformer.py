@@ -173,6 +173,7 @@ def transformer_logits(
     collect_moe_aux: bool = False,
     moe_top_k: int = 1,
     moe_impl: str = "masked",
+    remat: bool = False,
 ):
     """``tokens`` [B, L] int32 -> logits [B, L, vocab].
 
@@ -185,7 +186,13 @@ def transformer_logits(
     data path on an ``ep`` mesh: "masked" (exact masked compute, every
     chip sees all tokens) or "dispatch" (Switch all-to-all with capacity
     buffers — lower FLOPs/communication at scale, drops overflow
-    tokens)."""
+    tokens).
+
+    ``remat=True`` wraps each block in ``jax.checkpoint``: the backward
+    pass recomputes block activations instead of saving them, so
+    activation memory is O(1) in depth — the standard FLOPs-for-HBM
+    trade for long-context / deep training (MoE blocks stay un-rematted
+    when ``collect_moe_aux`` needs their intermediate activations)."""
     if moe_impl not in ("masked", "dispatch"):
         raise ValueError(
             f"unknown moe_impl {moe_impl!r}; expected 'masked' or "
@@ -214,29 +221,39 @@ def transformer_logits(
         moe_load_balance_loss,
     )
 
+    def run_dense(block, h):
+        return _dense_block(
+            block, h, n_heads, causal, attn_impl, mesh, batch_axis
+        )
+
+    def run_moe(block, h_in):
+        h = _ln(h_in, block["ln1"])
+        y = h_in + _attention(
+            h, block, n_heads, causal, attn_impl, mesh, batch_axis
+        )
+        h = _ln(y, block["ln2"])
+        if mesh is not None and EXPERT_AXIS in mesh.axis_names:
+            apply = (
+                moe_dispatch_apply if moe_impl == "dispatch" else moe_apply
+            )
+            return y + apply(block["moe"], h, mesh=mesh, k=moe_top_k), h
+        return y + moe_ffn(block["moe"], h, k=moe_top_k), h
+
+    if remat:
+        run_dense = jax.checkpoint(run_dense)
+        if not collect_moe_aux:
+            run_moe = jax.checkpoint(run_moe)
+
     moe_aux = 0.0
     for block in params["blocks"]:
         if "moe" in block:
-            h = _ln(x, block["ln1"])
-            x = x + _attention(
-                h, block, n_heads, causal, attn_impl, mesh, batch_axis
-            )
-            h = _ln(x, block["ln2"])
-            if mesh is not None and EXPERT_AXIS in mesh.axis_names:
-                apply = (
-                    moe_dispatch_apply if moe_impl == "dispatch" else moe_apply
-                )
-                x = x + apply(block["moe"], h, mesh=mesh, k=moe_top_k)
-            else:
-                x = x + moe_ffn(block["moe"], h, k=moe_top_k)
+            x, h_mid = run_moe(block, x)
             if collect_moe_aux:
                 moe_aux = moe_aux + moe_load_balance_loss(
-                    block["moe"], h, k=moe_top_k
+                    block["moe"], h_mid, k=moe_top_k
                 )
         else:
-            x = _dense_block(
-                block, x, n_heads, causal, attn_impl, mesh, batch_axis
-            )
+            x = run_dense(block, x)
     x = _ln(x, params["ln_f"])
     logits = x @ embed.T
     if collect_moe_aux:
@@ -355,7 +372,7 @@ def transformer_generate(
 def token_nll(
     params: Params, tokens, attn_impl: str = "reference", mesh=None,
     batch_axis=None, collect_moe_aux: bool = False, moe_top_k: int = 1,
-    moe_impl: str = "masked",
+    moe_impl: str = "masked", remat: bool = False,
 ):
     """Per-position next-token negative log-likelihood ``[B, L-1]`` — the
     one implementation both training loss and frame scoring reduce over.
@@ -367,7 +384,7 @@ def token_nll(
     fwd = transformer_logits(
         params, tokens[:, :-1], causal=True, attn_impl=attn_impl, mesh=mesh,
         batch_axis=batch_axis, collect_moe_aux=collect_moe_aux,
-        moe_top_k=moe_top_k, moe_impl=moe_impl,
+        moe_top_k=moe_top_k, moe_impl=moe_impl, remat=remat,
     )
     logits, aux = fwd if collect_moe_aux else (fwd, None)
     targets = tokens[:, 1:]
@@ -382,7 +399,7 @@ def token_nll(
 def transformer_loss(
     params: Params, tokens, attn_impl: str = "reference", mesh=None,
     batch_axis=None, moe_aux_weight: float = 0.0, moe_top_k: int = 1,
-    moe_impl: str = "masked",
+    moe_impl: str = "masked", remat: bool = False,
 ):
     """Next-token cross entropy (mean over all predicted positions).
 
@@ -393,12 +410,13 @@ def transformer_loss(
         nll, aux = token_nll(
             params, tokens, attn_impl=attn_impl, mesh=mesh,
             batch_axis=batch_axis, collect_moe_aux=True,
-            moe_top_k=moe_top_k, moe_impl=moe_impl,
+            moe_top_k=moe_top_k, moe_impl=moe_impl, remat=remat,
         )
         return nll.mean() + moe_aux_weight * aux
     return token_nll(
         params, tokens, attn_impl=attn_impl, mesh=mesh,
         batch_axis=batch_axis, moe_top_k=moe_top_k, moe_impl=moe_impl,
+        remat=remat,
     ).mean()
 
 
@@ -473,6 +491,7 @@ class TransformerLM:
         moe_top_k: int = 1,
         moe_impl: str = "masked",
         attn_impl: str = "reference",
+        remat: bool = False,
     ):
         """Jitted SGD on next-token loss. Single chip by default; pass a
         mesh with an ``ep`` axis to train MoE blocks expert-parallel
@@ -492,6 +511,8 @@ class TransformerLM:
             kw["moe_impl"] = moe_impl
         if attn_impl != "reference":
             kw["attn_impl"] = attn_impl
+        if remat:
+            kw["remat"] = True
         return self._sgd_loop(tokens, steps, lr, loss_kwargs=kw)
 
     def fit_tp(
@@ -784,17 +805,18 @@ class TransformerLM:
         moe_top_k: int = 1,
     ):
         """KV-cached autoregressive decode (:func:`transformer_generate`)
-        as one jitted scan program, memoized per (params identity, prompt
-        shape, decode config) in a dict — alternating configs or seeds
-        reuse their compiled programs (greedy decodes ignore ``seed``: it
-        never enters the program); a new fit invalidates all entries
-        because it replaces the params object the keys carry."""
+        as one jitted scan program, memoized per (prompt shape, decode
+        config) in a dict. The weights enter the program as an ARGUMENT,
+        not as baked constants: a re-fit model reuses the same compiled
+        program with its new params (nothing stale is pinned, no
+        recompile), and alternating configs or seeds each reuse their own
+        entry (greedy decodes ignore ``seed`` — it never enters the
+        program)."""
         import jax
 
         prompt = np.asarray(prompt, dtype=np.int32)
         sampled = bool(temperature and temperature > 0)
         key = (
-            id(self.params),
             prompt.shape,
             int(max_new_tokens),
             float(temperature) if sampled else 0.0,
@@ -806,12 +828,12 @@ class TransformerLM:
             cache = self._generate_cache = {}
         run = cache.get(key)
         if run is None:
-            params = self.params
+            static = self.params["n_heads"]
 
-            def impl(p):
+            def impl(p, prompt_arr):
                 return transformer_generate(
-                    params,
-                    p,
+                    {**p, "n_heads": static},
+                    prompt_arr,
                     max_new_tokens,
                     temperature=temperature,
                     seed=seed,
@@ -819,7 +841,19 @@ class TransformerLM:
                 )
 
             run = cache[key] = jax.jit(impl)
-        return np.asarray(run(prompt))
+        # one memoized device copy of the weights, replaced when fit
+        # swaps the params object (the old copy is then collectable —
+        # exactly one generation's weights are ever pinned)
+        dev = getattr(self, "_generate_params", None)
+        if dev is None or dev[0] is not self.params:
+            host = {
+                k: v for k, v in self.params.items() if k != "n_heads"
+            }
+            dev = self._generate_params = (
+                self.params,
+                jax.device_put(host),
+            )
+        return np.asarray(run(dev[1], prompt))
 
     def score_frame(
         self,

@@ -181,6 +181,32 @@ class TestFitShardedDpSp:
             lm.fit_sharded(toks, mesh, steps=1, attn_impl="reference")
 
 
+class TestRemat:
+    def test_remat_fit_matches_plain_fit(self):
+        # jax.checkpoint must be semantics-preserving: identical losses,
+        # only the backward's memory/FLOP trade differs
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 16, size=(4, 12)).astype(np.int32)
+        lm1 = TransformerLM.init(0, 16, d_model=16, n_heads=4, max_len=12)
+        plain = lm1.fit(toks, steps=4, lr=0.2)
+        lm2 = TransformerLM.init(0, 16, d_model=16, n_heads=4, max_len=12)
+        remat = lm2.fit(toks, steps=4, lr=0.2, remat=True)
+        np.testing.assert_allclose(remat, plain, rtol=1e-5, atol=1e-6)
+
+    def test_remat_with_flash_and_moe(self):
+        rng = np.random.default_rng(1)
+        toks = rng.integers(0, 16, size=(2, 129)).astype(np.int32)
+        lm = TransformerLM.init(0, 16, d_model=16, n_heads=4, max_len=129)
+        losses = lm.fit(toks, steps=2, lr=0.2, attn_impl="flash", remat=True)
+        assert all(np.isfinite(losses))
+        toks2 = rng.integers(0, 16, size=(2, 9)).astype(np.int32)
+        lm2 = TransformerLM.init(
+            0, 16, d_model=16, n_heads=4, max_len=12, moe_experts=4
+        )
+        l2 = lm2.fit(toks2, steps=2, lr=0.2, remat=True)
+        assert all(np.isfinite(l2))
+
+
 class TestGenerate:
     """KV-cached scan decode vs the naive oracle: re-run the full forward
     on the growing sequence and argmax the last position."""
@@ -245,6 +271,26 @@ class TestGenerate:
             lm.generate(np.zeros((1, 6), np.int32), max_new_tokens=8)
         with pytest.raises(ValueError, match="max_new_tokens"):
             lm.generate(np.zeros((1, 6), np.int32), max_new_tokens=0)
+
+    def test_generate_composes_with_map_blocks(self):
+        # decode over a FRAME of prompts: generation is just another
+        # captured program through the dataframe plane
+        import tensorframes_tpu as tft
+        from tensorframes_tpu.models import transformer_generate
+
+        rng = np.random.default_rng(5)
+        lm = TransformerLM.init(1, 16, d_model=16, n_heads=4, max_len=16)
+        prompts = rng.integers(0, 16, size=(6, 4)).astype(np.int32)
+        df = tft.TensorFrame.from_columns({"prompt": prompts}).analyze()
+        params = lm.params
+
+        def gen_fn(prompt):
+            return {"gen": transformer_generate(params, prompt, 5)}
+
+        out = tft.map_blocks(gen_fn, df)
+        got = np.asarray(out.cache().column_block("gen"))
+        want = lm.generate(prompts, max_new_tokens=5)
+        np.testing.assert_array_equal(got, want)
 
     def test_compiled_programs_reused_across_configs(self):
         # alternating seeds/configs must hit the memo dict, and greedy
