@@ -25,7 +25,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["flash_attention", "attention_reference", "online_block_update"]
+__all__ = [
+    "flash_attention",
+    "attention_reference",
+    "online_block_update",
+    "flash_carry",
+    "flash_bwd_pair",
+]
 
 _NEG_BIG = -0.7 * float(np.finfo(np.float32).max)  # mask value; exp() == 0
 #: log-sum-exp sentinel for rows that attend to nothing (causal with more
@@ -97,6 +103,28 @@ def online_block_update(
 
 def _finalize(l: jnp.ndarray, acc: jnp.ndarray) -> jnp.ndarray:
     return acc / jnp.maximum(l, 1e-30)
+
+
+def _lse_sentinel(m: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
+    """Per-row log-sum-exp saved for the backward, with the ``_POS_BIG``
+    sentinel on rows that attended to nothing (so the backward recomputes
+    p == 0 and zero gradient there). The single source of this convention
+    — the flash kernel's emit and the ring forward both use it; the
+    backward's empty-row guarantee depends on them being bit-identical."""
+    return jnp.where(
+        l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _POS_BIG
+    )
+
+
+def _check_tiles(block_q, lq, block_k, lk):
+    """The public kernel entry points floor-divide the grid; a block that
+    does not divide its sequence would silently drop the tail rows."""
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"block sizes ({block_q}, {block_k}) must divide the sequence "
+            f"lengths ({lq}, {lk}); see _fit_tile / flash_attention for "
+            f"automatic fitting"
+        )
 
 
 def attention_reference(
@@ -186,11 +214,8 @@ def _flash_kernel(
     @pl.when(ik == nk - 1)
     def _emit():
         o_ref[0] = _finalize(l_scr[:], acc_scr[:]).astype(o_ref.dtype)
-        l = l_scr[:]  # [bq, 1]
-        lse = jnp.where(
-            l > 0.0, m_scr[:] + jnp.log(jnp.maximum(l, 1e-30)), _POS_BIG
-        )
-        lse_ref[0] = lse  # [bq, 1] rows saved for the backward pass
+        # [bq, 1] rows saved for the backward pass
+        lse_ref[0] = _lse_sentinel(m_scr[:], l_scr[:])
 
 
 def _fit_tile(block, length):
@@ -278,6 +303,123 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, lq, d), lse
+
+
+def _flash_carry_kernel(
+    q_ref, k_ref, v_ref, m_in_ref, l_in_ref, acc_in_ref,
+    m_out_ref, l_out_ref, acc_out_ref, m_scr, l_scr, acc_scr,
+    *, block_q, block_k, causal, offset, scale,
+):
+    """Carry-mode forward: like :func:`_flash_kernel` but the online-softmax
+    state STARTS from an incoming (m, l, acc) and is emitted un-finalized.
+    This is the building block ring attention folds one visiting k/v chunk
+    with — per-chip memory stays O(block), never O((L/n)^2), because the
+    chunk streams through VMEM one [block_k, d] tile at a time exactly as
+    in the single-chip kernel."""
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = m_in_ref[0]
+        l_scr[:] = l_in_ref[0]
+        acc_scr[:] = acc_in_ref[0]
+
+    def update(with_mask):
+        mask = (
+            _frontier_mask(iq, ik, block_q, block_k, offset)
+            if with_mask
+            else None
+        )
+        m, l, acc = online_block_update(
+            q_ref[0], k_ref[0], v_ref[0],
+            m_scr[:], l_scr[:], acc_scr[:], scale, mask,
+        )
+        m_scr[:] = m
+        l_scr[:] = l
+        acc_scr[:] = acc
+
+    if causal:
+        visible, interior = _causal_tile_regimes(
+            iq, ik, block_q, block_k, offset
+        )
+
+        @pl.when(interior)
+        def _():
+            update(with_mask=False)
+
+        @pl.when(jnp.logical_and(visible, jnp.logical_not(interior)))
+        def _():
+            update(with_mask=True)
+
+    else:
+        update(with_mask=False)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        m_out_ref[0] = m_scr[:]
+        l_out_ref[0] = l_scr[:]
+        acc_out_ref[0] = acc_scr[:]
+
+
+def flash_carry(
+    q, k, v, m, l, acc, *, causal, offset, block_q, block_k, interpret
+):
+    """Fold one key/value span into an online-softmax carry with the flash
+    kernel. Flat layout: ``q`` [BH, Lq, D]; ``k``/``v`` [BH, Lk, D]; carry
+    ``m``/``l`` [BH, Lq, 1] and ``acc`` [BH, Lq, D], all f32. Returns the
+    updated (m, l, acc), not finalized — callers chain spans (ring hops)
+    and finalize once. ``offset`` is the static causal diagonal offset
+    (``q_global - k_global`` of the first elements); only the diagonal
+    ring hop is causal and there it is 0."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    _check_tiles(block_q, lq, block_k, lk)
+    scale = 1.0 / float(np.sqrt(d))
+    kernel = functools.partial(
+        _flash_carry_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+        offset=offset,
+        scale=scale,
+    )
+    q_spec = pl.BlockSpec(
+        (1, block_q, d), lambda bi, qi, ki: (bi, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    k_spec = pl.BlockSpec(
+        (1, block_k, d), lambda bi, qi, ki: (bi, ki, 0),
+        memory_space=pltpu.VMEM,
+    )
+    row_spec = pl.BlockSpec(
+        (1, block_q, 1), lambda bi, qi, ki: (bi, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, lq // block_q, lk // block_k),
+        in_specs=[q_spec, k_spec, k_spec, row_spec, row_spec, q_spec],
+        out_specs=[row_spec, row_spec, q_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_dim_semantics(pltpu, interpret),
+        interpret=interpret,
+    )(q, k, v, m, l, acc)
 
 
 def _bwd_tile_terms(q, kj, vj, do, lse, dlt, scale, mask):
@@ -469,15 +611,10 @@ def _flash_core_bwd(causal, block_q, block_k, interpret, res, do):
     the saved per-row log-sum-exp, never materializing [L, L]. Two pallas
     calls — dq accumulates over k tiles, dk/dv over q tiles — with the
     same causal skip/frontier regimes as the forward."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
     q, k, v, o, lse = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
     bh = b * h
-    scale = 1.0 / float(np.sqrt(d))
-    offset = lk - lq
     qf = q.reshape(bh, lq, d)
     kf = k.reshape(bh, lk, d)
     vf = v.reshape(bh, lk, d)
@@ -486,6 +623,37 @@ def _flash_core_bwd(causal, block_q, block_k, interpret, res, do):
     delta = (
         dof.astype(jnp.float32) * o.reshape(bh, lq, d).astype(jnp.float32)
     ).sum(axis=-1, keepdims=True)
+    dq, dk, dv = flash_bwd_pair(
+        qf, kf, vf, dof, lse, delta,
+        causal=causal, offset=lk - lq,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        out_dtypes=(q.dtype, k.dtype, v.dtype),
+    )
+    return (
+        dq.reshape(b, h, lq, d),
+        dk.reshape(b, h, lk, d),
+        dv.reshape(b, h, lk, d),
+    )
+
+
+def flash_bwd_pair(
+    qf, kf, vf, dof, lse, delta, *,
+    causal, offset, block_q, block_k, interpret, out_dtypes,
+):
+    """The two FlashAttention-2 backward pallas calls for one q-span/k-span
+    pair, flat [BH, L, D] layout, with the causal diagonal at static
+    ``offset``. Shared by the single-chip VJP (offset = lk - lq) and the
+    ring backward (per-hop gradients; offset 0 on the diagonal hop).
+    ``out_dtypes`` picks the emitted (dq, dk, dv) dtypes — the ring passes
+    f32 so cross-hop accumulation never truncates."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, lq, d = qf.shape
+    lk = kf.shape[1]
+    _check_tiles(block_q, lq, block_k, lk)
+    scale = 1.0 / float(np.sqrt(d))
+    dq_dt, dk_dt, dv_dt = out_dtypes
 
     q_spec = pl.BlockSpec(
         (1, block_q, d), lambda bi, qi, ki: (bi, qi, 0),
@@ -511,7 +679,7 @@ def _flash_core_bwd(causal, block_q, block_k, interpret, res, do):
         grid=(bh, lq // block_q, lk // block_k),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), dq_dt),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_dim_semantics(pltpu, interpret),
         interpret=interpret,
@@ -546,8 +714,8 @@ def _flash_core_bwd(causal, block_q, block_k, interpret, res, do):
         ],
         out_specs=[qk_k_spec, qk_k_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), dk_dt),
+            jax.ShapeDtypeStruct((bh, lk, d), dv_dt),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -557,11 +725,7 @@ def _flash_core_bwd(causal, block_q, block_k, interpret, res, do):
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
-    return (
-        dq.reshape(b, h, lq, d),
-        dk.reshape(b, h, lk, d),
-        dv.reshape(b, h, lk, d),
-    )
+    return dq, dk, dv
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
