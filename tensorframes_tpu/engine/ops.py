@@ -546,12 +546,75 @@ def map_blocks(
 
         STREAM_WINDOW = 2
         pending: "deque[int]" = deque()
+        #: pieces index -> partition index, so a failure surfacing at
+        #: materialization can be traced back and re-run selectively
+        piece_part: List[int] = []
+
+        def compute_partition(p: int):
+            """Dispatch one partition's program (feed assembly included) —
+            called by the main loop AND by materialization-time recovery,
+            so a lost async result re-runs only its own partition."""
+            lo, hi = bounds[p]
+            n = hi - lo
+            feed = {ph: feeders[ph](lo, hi) for ph in binding}
+            feed.update(const_feed)
+            from ..utils import is_oom, run_with_retries
+
+            try:
+                return run_with_retries(
+                    lambda: jit_fn(feed), what=f"map_blocks partition {p}"
+                )
+            except Exception as e:
+                if is_oom(e):
+                    from ..utils.failures import DeviceOOMError
+
+                    raise DeviceOOMError(
+                        f"map_blocks partition {p} ({n} rows) exhausted "
+                        f"device memory; repartition the frame into smaller "
+                        f"blocks (block programs see a whole partition, so "
+                        f"the engine cannot split one for you)"
+                    ) from e
+                raise
 
         def drain_pending(to_size: int) -> None:
             while len(pending) > to_size:
                 idx = pending.popleft()
-                for nm in fetch_names:
-                    pieces[nm][idx] = np.asarray(pieces[nm][idx])
+                try:
+                    for nm in fetch_names:
+                        pieces[nm][idx] = np.asarray(pieces[nm][idx])
+                except Exception:
+                    _recover_piece(idx)
+
+        def _recover_piece(idx: int) -> None:
+            """A transient failure during ASYNC execution surfaces when the
+            partition's output is first touched; re-run just that
+            partition (completed partitions are never recomputed) and
+            materialize the replacement. Deterministic failures re-raise
+            from the re-run itself."""
+            p = piece_part[idx]
+            logger.warning(
+                "map_blocks partition %d result was lost to an async "
+                "failure; re-running that partition only", p,
+            )
+            res = compute_partition(p)
+            for nm in fetch_names:
+                pieces[nm][idx] = np.asarray(res[nm])
+
+        def _recover_lost_partitions() -> int:
+            """Probe every partition's result; re-run the poisoned ones.
+            Returns how many were recovered."""
+            recovered = 0
+            for idx in range(len(piece_part)):
+                probe = pieces[fetch_names[0]][idx]
+                try:
+                    if hasattr(probe, "block_until_ready"):
+                        probe.block_until_ready()
+                    else:
+                        np.asarray(probe)
+                except Exception:
+                    _recover_piece(idx)
+                    recovered += 1
+            return recovered
 
         try:
             for p in range(parent.num_partitions):
@@ -560,32 +623,15 @@ def map_blocks(
                 if n == 0:
                     part_sizes.append(0)
                     continue
-                feed = {ph: feeders[ph](lo, hi) for ph in binding}
-                feed.update(const_feed)
-                from ..utils import is_oom, run_with_retries
-
                 # NOTE: map_blocks keeps results device-resident so chained
                 # passes pipeline without host syncs (the 20x headline win in
-                # bench.py). The deliberate cost: only errors raised at
-                # DISPATCH are retried/classified here — a failure during
-                # async execution surfaces later, at materialization. map_rows
+                # bench.py). Only errors raised at DISPATCH are retried here;
+                # a failure during async execution surfaces later, at
+                # materialization — where _recover_lost_partitions re-runs
+                # just the partitions whose outputs were lost. map_rows
                 # and the reduces, which materialize promptly, sync inside
                 # their retry windows and get full coverage.
-                try:
-                    res = run_with_retries(
-                        lambda: jit_fn(feed), what=f"map_blocks partition {p}"
-                    )
-                except Exception as e:
-                    if is_oom(e):
-                        from ..utils.failures import DeviceOOMError
-
-                        raise DeviceOOMError(
-                            f"map_blocks partition {p} ({n} rows) exhausted "
-                            f"device memory; repartition the frame into smaller "
-                            f"blocks (block programs see a whole partition, so "
-                            f"the engine cannot split one for you)"
-                        ) from e
-                    raise
+                res = compute_partition(p)
                 # results stay device-resident: shape checks need no host sync,
                 # and the host transfer happens only on host access (collect /
                 # column host materialization) — chained ops feed from HBM
@@ -610,31 +656,57 @@ def map_blocks(
                         acc_bytes += arr.nbytes
                         if acc_bytes > budget:
                             streaming = True
-                            for nm in fetch_names:  # demote what's accumulated
-                                pieces[nm] = [np.asarray(a) for a in pieces[nm]]
+                            # demote what's accumulated — a lost async
+                            # result can surface at these asarray calls
+                            # too, so recover per piece like drain_pending
+                            for idx in range(len(piece_part)):
+                                try:
+                                    for nm in fetch_names:
+                                        pieces[nm][idx] = np.asarray(
+                                            pieces[nm][idx]
+                                        )
+                                except Exception:
+                                    _recover_piece(idx)
                     pieces[name].append(arr)
+                piece_part.append(p)
                 if streaming:
                     pending.append(len(pieces[fetch_names[0]]) - 1)
                     drain_pending(STREAM_WINDOW)
                 part_sizes.append(out_n if trim else n)
             drain_pending(0)
+
+            def build_cols() -> Dict[str, _ColumnData]:
+                out: Dict[str, _ColumnData] = {}
+                for name in fetch_names:
+                    ps = pieces[name]
+                    if not ps:
+                        dense = _empty_output(
+                            out_specs[name], block_output=True
+                        )
+                    elif len(ps) == 1:
+                        dense = ps[0]
+                    elif streaming:
+                        dense = np.concatenate(ps, axis=0)
+                    else:
+                        import jax.numpy as jnp
+
+                        dense = jnp.concatenate(ps, axis=0)  # on-device
+                    out[name] = _ColumnData(dense=dense)
+                return out
+
+            try:
+                cols = build_cols()
+            except Exception:
+                # an async-execution failure poisons its output buffers and
+                # resurfaces here, at the concatenation that first touches
+                # them: recover per partition and rebuild (decode feeders
+                # are still alive — the pool shuts down in the finally)
+                if _recover_lost_partitions() == 0:
+                    raise  # not a lost-result failure; propagate as-is
+                cols = build_cols()
         finally:
             if decode_pool is not None:
                 decode_pool.shutdown(wait=False, cancel_futures=True)
-        cols: Dict[str, _ColumnData] = {}
-        for name in fetch_names:
-            ps = pieces[name]
-            if not ps:
-                dense = _empty_output(out_specs[name], block_output=True)
-            elif len(ps) == 1:
-                dense = ps[0]
-            elif streaming:
-                dense = np.concatenate(ps, axis=0)
-            else:
-                import jax.numpy as jnp
-
-                dense = jnp.concatenate(ps, axis=0)  # on-device concat
-            cols[name] = _ColumnData(dense=dense)
         offsets = np.concatenate([[0], np.cumsum(part_sizes)]).astype(np.int64)
         if trim:
             return TensorFrame(cols, result_info, offsets=offsets)

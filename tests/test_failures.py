@@ -179,3 +179,131 @@ class TestEngineIntegration:
         df = TensorFrame.from_columns({"x": np.arange(4.0)})
         with pytest.raises(DeviceOOMError, match="one row per call"):
             tft.map_rows(lambda x: {"y": x * 2.0}, df).cache()
+
+
+class _PoisonedResult:
+    """Mimics a jax array whose async computation failed: shape metadata is
+    readable (the dispatch-time checks pass), but any materialization —
+    block_until_ready or conversion to numpy — raises the stored error."""
+
+    def __init__(self, real):
+        self._real = np.asarray(real)
+        self.shape = self._real.shape
+        self.nbytes = self._real.nbytes
+
+    def block_until_ready(self):
+        raise RuntimeError("UNAVAILABLE: injected mid-chain async failure")
+
+    def __array__(self, *a, **k):
+        raise RuntimeError("UNAVAILABLE: injected mid-chain async failure")
+
+
+class TestMidChainRecovery:
+    """A transient failure during ASYNC execution surfaces at
+    materialization; the engine must re-run only the partitions whose
+    outputs were lost — never the completed ones."""
+
+    def _flaky_backend(self, fail_call_idx):
+        real = engine_ops._jitted
+        calls = []
+
+        def jitted(g):
+            fn = real(g)
+
+            def wrapper(feed):
+                idx = len(calls)
+                calls.append(idx)
+                res = fn(feed)
+                if idx == fail_call_idx:
+                    return {k: _PoisonedResult(v) for k, v in res.items()}
+                return res
+
+            return wrapper
+
+        return jitted, calls
+
+    def test_device_resident_chain_recovers_lost_partition(
+        self, fast_retries, monkeypatch
+    ):
+        jitted, calls = self._flaky_backend(fail_call_idx=2)
+        monkeypatch.setattr(engine_ops, "_jitted", jitted)
+        df = TensorFrame.from_columns(
+            {"x": np.arange(8.0)}, num_partitions=4
+        )
+        out = tft.map_blocks(lambda x: {"z": x * 10.0}, df).collect()
+        assert [r.z for r in out] == [float(10 * i) for i in range(8)]
+        # 4 partitions + exactly ONE recovery re-run: completed partitions
+        # were not recomputed
+        assert len(calls) == 5
+
+    def test_streaming_mode_recovers_lost_partition(
+        self, fast_retries, monkeypatch
+    ):
+        from tensorframes_tpu.utils import get_config, set_config
+
+        jitted, calls = self._flaky_backend(fail_call_idx=1)
+        monkeypatch.setattr(engine_ops, "_jitted", jitted)
+        old = get_config().device_cache_bytes
+        set_config(device_cache_bytes=64)  # force host-streaming drains
+        try:
+            df = TensorFrame.from_columns(
+                {"x": np.arange(12.0)}, num_partitions=4
+            )
+            out = tft.map_blocks(lambda x: {"z": x + 5.0}, df).collect()
+            assert [r.z for r in out] == [float(i + 5) for i in range(12)]
+            assert len(calls) == 5
+        finally:
+            set_config(device_cache_bytes=old)
+
+    def test_deterministic_failure_still_raises(
+        self, fast_retries, monkeypatch
+    ):
+        # every run of partition 2 is poisoned: recovery must re-raise, not
+        # loop
+        real = engine_ops._jitted
+        calls = []
+
+        def jitted(g):
+            fn = real(g)
+
+            def wrapper(feed):
+                idx = len(calls)
+                calls.append(idx)
+                res = fn(feed)
+                if float(np.asarray(next(iter(res.values())))[0]) == 40.0:
+                    return {k: _PoisonedResult(v) for k, v in res.items()}
+                return res
+
+            return wrapper
+
+        monkeypatch.setattr(engine_ops, "_jitted", jitted)
+        df = TensorFrame.from_columns(
+            {"x": np.arange(8.0)}, num_partitions=4
+        )
+        with pytest.raises(RuntimeError, match="injected mid-chain"):
+            tft.map_blocks(lambda x: {"z": x * 10.0}, df).collect()
+
+    def test_demote_to_streaming_recovers_lost_partition(
+        self, fast_retries, monkeypatch
+    ):
+        # trim maps have no static output-size estimate, so they start
+        # device-resident and DEMOTE to host streaming when accumulated
+        # bytes cross the budget mid-run — the demotion's host pulls must
+        # recover lost results too
+        from tensorframes_tpu.utils import get_config, set_config
+
+        jitted, calls = self._flaky_backend(fail_call_idx=0)
+        monkeypatch.setattr(engine_ops, "_jitted", jitted)
+        old = get_config().device_cache_bytes
+        set_config(device_cache_bytes=20)  # crosses after two partitions
+        try:
+            df = TensorFrame.from_columns(
+                {"x": np.arange(8.0)}, num_partitions=4
+            )
+            out = tft.map_blocks(
+                lambda x: {"z": x * 2.0}, df, trim=True
+            ).collect()
+            assert [r.z for r in out] == [float(2 * i) for i in range(8)]
+            assert len(calls) == 5  # 4 partitions + 1 recovery
+        finally:
+            set_config(device_cache_bytes=old)
