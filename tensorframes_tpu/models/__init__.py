@@ -12,7 +12,9 @@ from .cnn import CNNScorer, cnn_embed, cnn_logits, init_cnn
 from .kmeans import kmeans, assign_clusters
 from .transformer import (
     TransformerLM,
+    filter_logits,
     init_transformer,
+    left_pad_prompts,
     transformer_generate,
     transformer_logits,
     transformer_loss,
@@ -28,6 +30,8 @@ __all__ = [
     "transformer_generate",
     "transformer_logits",
     "transformer_loss",
+    "filter_logits",
+    "left_pad_prompts",
     "MLPClassifier",
     "init_mlp",
     "mlp_apply",

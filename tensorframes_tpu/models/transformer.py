@@ -27,6 +27,8 @@ __all__ = [
     "transformer_loss",
     "token_nll",
     "TransformerLM",
+    "filter_logits",
+    "left_pad_prompts",
 ]
 
 Params = Dict[str, Any]
@@ -261,13 +263,66 @@ def transformer_logits(
     return logits
 
 
+def _is_concrete_scalar(x) -> bool:
+    """True when ``x`` is a plain Python/numpy number (its VALUE may steer
+    trace-time structure); False for tracers (value unknown — the caller
+    must have opted into the sampled/filtered program shape)."""
+    return isinstance(x, (int, float, np.integer, np.floating))
+
+
+def filter_logits(logits, top_k: int = 0, top_p=1.0):
+    """Top-k / nucleus (top-p) logit filtering, [B, V] -> [B, V] with
+    masked-out entries at a large negative. ``top_k`` is static (0 = off);
+    ``top_p`` may be a traced scalar (1.0 = off when concrete). Nucleus
+    keeps the smallest prefix of descending-probability tokens whose
+    cumulative mass reaches ``top_p`` (the first token always survives, so
+    a tiny top_p degrades to greedy, not to an empty support)."""
+    import jax
+    import jax.numpy as jnp
+
+    neg = jnp.finfo(jnp.float32).min * 0.7
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None and not (
+        _is_concrete_scalar(top_p) and top_p >= 1.0
+    ):
+        sl = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        ps = jax.nn.softmax(sl, axis=-1)
+        css = jnp.cumsum(ps, axis=-1)
+        # token j (sorted order) kept iff the mass BEFORE it is < top_p
+        keep = (css - ps) < top_p
+        k_eff = keep.sum(axis=-1, keepdims=True)  # >= 1 by construction
+        thresh = jnp.take_along_axis(sl, k_eff - 1, axis=-1)
+        logits = jnp.where(logits < thresh, neg, logits)
+    return logits
+
+
+def left_pad_prompts(seqs, pad_id: int = 0):
+    """Pack variable-length prompts into the left-padded ``[B, P]`` layout
+    :func:`transformer_generate` takes for ragged batches (each row's
+    tokens right-aligned at positions ``P-len..P-1``). Returns
+    ``(prompt, lengths)``."""
+    lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
+    if (lengths < 1).any():
+        raise ValueError("every prompt needs at least one token")
+    p = int(lengths.max())
+    out = np.full((len(seqs), p), pad_id, dtype=np.int32)
+    for i, s in enumerate(seqs):
+        out[i, p - len(s):] = np.asarray(s, dtype=np.int32)
+    return out, lengths
+
+
 def transformer_generate(
     params: Params,
     prompt,
     max_new_tokens: int,
-    temperature: float = 0.0,
-    seed: int = 0,
+    temperature=0.0,
+    seed=0,
     moe_top_k: int = 1,
+    top_k: int = 0,
+    top_p=1.0,
+    prompt_lengths=None,
 ):
     """Autoregressive decode with a KV cache, compiled as ONE
     ``lax.scan`` program: per step the new token's q/k/v are computed,
@@ -278,9 +333,20 @@ def transformer_generate(
     recompiles per length and recomputes O(L^2) work per token).
 
     ``temperature`` 0 = greedy argmax; > 0 samples categorically with a
-    per-step key folded from ``seed``. Returns ``[B, P + max_new_tokens]``
-    int32 (prompt included). ``prompt + max_new_tokens`` must fit
-    ``max_len`` (the positional table)."""
+    per-step key folded from ``seed``, after :func:`filter_logits` applies
+    ``top_k`` / nucleus ``top_p`` truncation. ``temperature``, ``seed``
+    and ``top_p`` may be TRACED scalars (pass them as jit arguments — one
+    compiled program serves every seed/temperature sweep); ``top_k`` is
+    static. Returns ``[B, P + max_new_tokens]`` int32 (prompt included).
+    ``prompt + max_new_tokens`` must fit ``max_len`` (the positional
+    table).
+
+    Ragged batches: pass LEFT-padded prompts (each row's tokens at
+    positions ``P-len..P-1``; :func:`left_pad_prompts` packs them) plus
+    ``prompt_lengths`` [B]. Pad slots are excluded from attention and
+    per-row position offsets keep the positional table aligned, so every
+    row decodes exactly as it would alone; generation starts at the shared
+    slot ``P`` for all rows."""
     import jax
     import jax.numpy as jnp
 
@@ -308,6 +374,13 @@ def transformer_generate(
     blocks = params["blocks"]
     scale = 1.0 / float(np.sqrt(hd))
     neg = jnp.finfo(jnp.float32).min * 0.7
+    # greedy vs sampled is a STRUCTURAL choice: concrete temperature <= 0
+    # means greedy; a traced temperature always means the sampled program
+    sampled = not (_is_concrete_scalar(temperature) and temperature <= 0)
+    if prompt_lengths is None:
+        offsets = jnp.zeros((bsz,), jnp.int32)
+    else:
+        offsets = plen - jnp.asarray(prompt_lengths, dtype=jnp.int32)
 
     k0 = jnp.zeros((len(blocks), bsz, n_heads, total, hd), jnp.float32)
     v0 = jnp.zeros_like(k0)
@@ -321,7 +394,14 @@ def transformer_generate(
             ),
             prev,
         )
-        h = embed[tok] + posemb[t]  # [B, D]
+        # per-row position offset: a left-padded row's token at slot t sits
+        # at real position t - offset (pad slots gather position 0; they
+        # are masked out of attention below, so the value never matters)
+        h = embed[tok] + posemb[jnp.clip(t - offsets, 0, total - 1)]
+        # visible = causal AND not a pad slot (slot j belongs to row b's
+        # prompt iff j >= offsets[b])
+        slots = jnp.arange(total)[None, :]
+        visible = (slots <= t) & (slots >= offsets[:, None])  # [B, T]
         for li, block in enumerate(blocks):
             x = _ln(h, block["ln1"])
             qkv = x @ jnp.asarray(block["qkv"])
@@ -338,7 +418,7 @@ def transformer_generate(
                 (li, 0, 0, t, 0),
             )
             s = jnp.einsum("bhd,bhtd->bht", q, kc[li]) * scale
-            s = jnp.where(jnp.arange(total)[None, None, :] <= t, s, neg)
+            s = jnp.where(visible[:, None, :], s, neg)
             att = jnp.einsum(
                 "bht,bhtd->bhd", jax.nn.softmax(s, axis=-1), vc[li]
             ).reshape(bsz, d_model)
@@ -353,9 +433,15 @@ def transformer_generate(
                     jnp.asarray(block["down"])
                 )
         logits = _ln(h, params["ln_f"]) @ embed.T
-        if temperature and temperature > 0:
+        if sampled:
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
-            nxt = jax.random.categorical(key, logits / temperature, axis=-1)
+            scaled = logits / jnp.maximum(
+                jnp.asarray(temperature, jnp.float32), 1e-6
+            )
+            nxt = jax.random.categorical(
+                key, filter_logits(scaled, top_k=top_k, top_p=top_p),
+                axis=-1,
+            )
         else:
             nxt = jnp.argmax(logits, axis=-1)
         nxt = nxt.astype(jnp.int32)
@@ -365,7 +451,9 @@ def transformer_generate(
         step, (k0, v0, prompt[:, 0]), jnp.arange(total - 1)
     )
     # step t emits the prediction for position t+1: the generated tokens
-    # are the emissions of steps plen-1 .. total-2
+    # are the emissions of steps plen-1 .. total-2 (with left padding,
+    # every row's prompt ends at slot plen-1, so this holds for ragged
+    # batches too)
     return jnp.concatenate([prompt, outs[plen - 1 :].T], axis=1)
 
 
@@ -796,6 +884,11 @@ class TransformerLM:
         }
         return losses
 
+    #: compiled decode programs kept per (shape, decode STRUCTURE); seeds,
+    #: temperatures and top_p enter as traced arguments, so sweeps reuse
+    #: one program. Bounded: oldest entry evicted beyond this.
+    _GENERATE_CACHE_MAX = 16
+
     def generate(
         self,
         prompt,
@@ -803,44 +896,66 @@ class TransformerLM:
         temperature: float = 0.0,
         seed: int = 0,
         moe_top_k: int = 1,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        prompt_lengths=None,
     ):
         """KV-cached autoregressive decode (:func:`transformer_generate`)
         as one jitted scan program, memoized per (prompt shape, decode
-        config) in a dict. The weights enter the program as an ARGUMENT,
-        not as baked constants: a re-fit model reuses the same compiled
-        program with its new params (nothing stale is pinned, no
-        recompile), and alternating configs or seeds each reuse their own
-        entry (greedy decodes ignore ``seed`` — it never enters the
-        program)."""
+        STRUCTURE) in a bounded dict. The weights enter the program as an
+        ARGUMENT, not as baked constants: a re-fit model reuses the same
+        compiled program with its new params (nothing stale is pinned, no
+        recompile). ``seed``, ``temperature`` and ``top_p`` are traced
+        arguments too — sweeping them reuses ONE compiled program (greedy
+        decodes ignore all three; they never enter the program).
+
+        ``top_k`` / ``top_p`` truncate the sampling distribution (see
+        :func:`filter_logits`). ``prompt_lengths`` enables ragged batches
+        over LEFT-padded prompts (:func:`left_pad_prompts`)."""
         import jax
 
         prompt = np.asarray(prompt, dtype=np.int32)
         sampled = bool(temperature and temperature > 0)
+        use_p = top_p is not None and top_p < 1.0
+        ragged = prompt_lengths is not None
+        if ragged:
+            prompt_lengths = np.asarray(prompt_lengths, dtype=np.int32)
         key = (
             prompt.shape,
             int(max_new_tokens),
-            float(temperature) if sampled else 0.0,
-            int(seed) if sampled else 0,
+            sampled,
+            int(top_k) if sampled else 0,
+            use_p and sampled,
             int(moe_top_k),
+            ragged,
         )
         cache = getattr(self, "_generate_cache", None)
         if cache is None:
-            cache = self._generate_cache = {}
+            from collections import OrderedDict
+
+            cache = self._generate_cache = OrderedDict()
         run = cache.get(key)
-        if run is None:
+        if run is not None:
+            cache.move_to_end(key)
+        else:
             static = self.params["n_heads"]
 
-            def impl(p, prompt_arr):
+            def impl(p, prompt_arr, seed_arr, temp_arr, top_p_arr, lens):
                 return transformer_generate(
                     {**p, "n_heads": static},
                     prompt_arr,
                     max_new_tokens,
-                    temperature=temperature,
-                    seed=seed,
+                    temperature=temp_arr if sampled else 0.0,
+                    seed=seed_arr,
                     moe_top_k=moe_top_k,
+                    top_k=top_k if sampled else 0,
+                    top_p=top_p_arr if (sampled and use_p) else 1.0,
+                    prompt_lengths=lens,
                 )
 
             run = cache[key] = jax.jit(impl)
+            while len(cache) > self._GENERATE_CACHE_MAX:
+                cache.popitem(last=False)
         # one memoized device copy of the weights, replaced when fit
         # swaps the params object (the old copy is then collectable —
         # exactly one generation's weights are ever pinned)
@@ -853,7 +968,16 @@ class TransformerLM:
                 self.params,
                 jax.device_put(host),
             )
-        return np.asarray(run(dev[1], prompt))
+        return np.asarray(
+            run(
+                dev[1],
+                prompt,
+                np.int32(seed),
+                np.float32(temperature if sampled else 0.0),
+                np.float32(top_p if use_p else 1.0),
+                prompt_lengths,
+            )
+        )
 
     def score_frame(
         self,
