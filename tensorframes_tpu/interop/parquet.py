@@ -93,24 +93,32 @@ def scan_parquet(path: str, row_groups_per_block: int = 1, prefetch: int = 2):
     from .arrow import from_arrow
 
     pf = pq.ParquetFile(path)
-    ngroups = pf.num_row_groups
-    spans = [
-        list(range(lo, min(lo + row_groups_per_block, ngroups)))
-        for lo in range(0, ngroups, row_groups_per_block)
-    ]
+    try:
+        ngroups = pf.num_row_groups
+        spans = [
+            list(range(lo, min(lo + row_groups_per_block, ngroups)))
+            for lo in range(0, ngroups, row_groups_per_block)
+        ]
 
-    def read(span):
-        return pf.read_row_groups(span)
+        def read(span):
+            return pf.read_row_groups(span)
 
-    with cf.ThreadPoolExecutor(max_workers=1) as pool:
-        pending = [pool.submit(read, s) for s in spans[: max(1, prefetch)]]
-        nxt = len(pending)
-        for _ in spans:
-            table = pending.pop(0).result()
-            if nxt < len(spans):
-                pending.append(pool.submit(read, spans[nxt]))
-                nxt += 1
-            yield from_arrow(table)
+        with cf.ThreadPoolExecutor(max_workers=1) as pool:
+            pending = [
+                pool.submit(read, s) for s in spans[: max(1, prefetch)]
+            ]
+            nxt = len(pending)
+            for _ in spans:
+                table = pending.pop(0).result()
+                if nxt < len(spans):
+                    pending.append(pool.submit(read, spans[nxt]))
+                    nxt += 1
+                yield from_arrow(table)
+    finally:
+        # closes the handle even when the consumer abandons the generator
+        # mid-stream (GeneratorExit runs this finally), so streaming many
+        # files never accumulates open descriptors
+        pf.close()
 
 
 def map_parquet(

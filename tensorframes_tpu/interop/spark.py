@@ -80,6 +80,7 @@ def arrow_batch_mapper(
     decoders=None,
     constants=None,
     batch_rows: int = 0,
+    streaming: bool = False,
 ):
     """Build the executor-side function for ``DataFrame.mapInArrow``:
     ``fn(iterator[pyarrow.RecordBatch]) -> iterator[pyarrow.RecordBatch]``.
@@ -112,19 +113,17 @@ def arrow_batch_mapper(
     has bytes cells, not utf8), so declare carried-through string fields
     as ``binary`` in the Spark output schema (or drop them with
     ``trim=True``). Numeric columns round-trip exactly.
+
+    ``streaming=True`` runs the program per INCOMING BATCH instead of
+    buffering the partition, so executor memory stays bounded at one
+    batch — use it only for ROW-LOCAL programs (elementwise maps, where no
+    result depends on which rows share a block): cross-row block ops
+    would see Spark's arbitrary Arrow chunking instead of the partition.
     """
     from .. import engine
     from .arrow import from_arrow, to_arrow
 
-    def fn(batches):
-        import pyarrow as pa
-
-        batches = list(batches)
-        if not batches:
-            return
-        table = pa.Table.from_batches(batches)
-        if table.num_rows == 0:
-            return
+    def run(table):
         df = from_arrow(table)
         out = engine.map_blocks(
             fetches,
@@ -140,6 +139,22 @@ def arrow_batch_mapper(
         else:
             yield from result.to_batches()
 
+    def fn(batches):
+        import pyarrow as pa
+
+        if streaming:
+            for batch in batches:
+                if batch.num_rows:
+                    yield from run(pa.Table.from_batches([batch]))
+            return
+        batches = list(batches)
+        if not batches:
+            return
+        table = pa.Table.from_batches(batches)
+        if table.num_rows == 0:
+            return
+        yield from run(table)
+
     return fn
 
 
@@ -152,6 +167,7 @@ def map_in_arrow(
     decoders=None,
     constants=None,
     batch_rows: int = 0,
+    streaming: bool = False,
 ):
     """Partition-wise ``map_blocks`` over a Spark DataFrame via
     ``DataFrame.mapInArrow`` — no driver collect; each executor scores its
@@ -159,7 +175,8 @@ def map_in_arrow(
     the Spark DDL schema string of the RESULT rows (fetch columns plus
     the input columns, or just the fetches with ``trim=True``; declare
     carried-through string columns as ``binary`` — see
-    :func:`arrow_batch_mapper`)."""
+    :func:`arrow_batch_mapper`). ``streaming=True`` bounds executor
+    memory at one Arrow batch; row-local programs only."""
     _require_spark()
     return spark_df.mapInArrow(
         arrow_batch_mapper(
@@ -169,6 +186,7 @@ def map_in_arrow(
             decoders=decoders,
             constants=constants,
             batch_rows=batch_rows,
+            streaming=streaming,
         ),
         output_schema,
     )

@@ -242,7 +242,11 @@ def _lazy_mh_result(res, g, local_df, mesh, out_specs, block_output, feed, bindi
     # ``unpersist_device``.
     budget = get_config().device_cache_bytes
     for ph, col in binding.items():
-        if feed[ph].nbytes <= budget:
+        # same byte basis as _global_feed_col's cache decision (per-process
+        # host bytes, not the global array): a column cached there must be
+        # registered here, or a chained op on a pass-through column would
+        # force the lazy frame and re-materialize every fetch column
+        if feed[ph].nbytes // process_count() <= budget:
             reg.setdefault(col, (mesh, feed[ph]))
     parent_reg = getattr(local_df, "_mh_global", None)
     if parent_reg:

@@ -169,6 +169,35 @@ class TestArrowBatchMapper:
         assert all(b.num_rows <= 2 for b in got)
         assert sum(b.num_rows for b in got) == 8
 
+    def test_streaming_mode_per_batch(self):
+        # streaming=True: row-local programs run per incoming batch with
+        # bounded memory; results identical to the buffered mode
+        pa = pytest.importorskip("pyarrow")
+
+        from tensorframes_tpu.interop.spark import arrow_batch_mapper
+
+        prog = lambda x: {"y": x * 3.0}
+        buffered = pa.Table.from_batches(
+            list(arrow_batch_mapper(prog)(iter(self._batches())))
+        )
+        streamed = pa.Table.from_batches(
+            list(arrow_batch_mapper(prog, streaming=True)(iter(self._batches())))
+        )
+        assert streamed.column("y").to_pylist() == buffered.column(
+            "y"
+        ).to_pylist()
+
+    def test_streaming_mode_skips_empty_batches(self):
+        pa = pytest.importorskip("pyarrow")
+
+        from tensorframes_tpu.interop.spark import arrow_batch_mapper
+
+        empty = pa.RecordBatch.from_pydict({"x": pa.array([], pa.float64())})
+        batches = [empty] + self._batches(n=4, per=2) + [empty]
+        fn = arrow_batch_mapper(lambda x: {"y": x + 1.0}, streaming=True)
+        table = pa.Table.from_batches(list(fn(iter(batches))))
+        assert table.num_rows == 4
+
     def test_no_driver_materialization(self):
         # feeding a generator (not a list) works — the exact iterator
         # contract Spark executes
