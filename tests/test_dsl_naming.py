@@ -1,0 +1,191 @@
+"""Golden tests pinning DSL naming semantics.
+
+The reference differential-tests its DSL's emitted NodeDefs node-by-node
+against real TF (ExtractNodes.scala:13-74); numerics tests alone would let
+scope/auto-number behavior drift silently. These goldens pin the exact
+name strings the DSL produces — a drifted name fails the suite.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.capture import dsl
+from tensorframes_tpu.capture.dsl import build_graph, graph, scope
+
+
+@pytest.fixture
+def df():
+    return tft.TensorFrame.from_columns(
+        {"x": np.arange(4, dtype=np.float64)}
+    )
+
+
+class TestAutoNumbering:
+    def test_first_use_is_bare_then_suffixed(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+            a = x + x
+            b = x + a
+            c = a * b
+            d = a * c
+        assert x.name == "x"
+        assert a.name == "add"
+        assert b.name == "add_1"
+        assert c.name == "mul"
+        assert d.name == "mul_1"
+
+    def test_counters_are_per_op_name(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+            nodes = [x + 1.0, x - 1.0, x * 2.0, x + 2.0, x - 2.0]
+        assert [n.name for n in nodes] == ["add", "sub", "mul", "add_1", "sub_1"]
+
+    def test_graph_resets_counters(self, df):
+        with graph():
+            first = dsl.block(df, "x") + 1.0
+        with graph():
+            second = dsl.block(df, "x") + 1.0
+        assert first.name == "add"
+        assert second.name == "add"
+
+    def test_apply_op_default_and_custom_op_name(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+            o1 = dsl.apply_op(lambda a: a * 3.0, x)
+            o2 = dsl.apply_op(lambda a: a * 3.0, x)
+            s = dsl.apply_op(lambda a: a.sum(), x, op_name="reduce_sum")
+        assert o1.name == "op"
+        assert o2.name == "op_1"
+        assert s.name == "reduce_sum"
+
+
+class TestScopes:
+    def test_scope_prefixes_with_slash(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+            with scope("layer"):
+                a = x + 1.0
+        assert a.name == "layer/add"
+
+    def test_nested_scopes_join(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+            with scope("outer"):
+                with scope("inner"):
+                    a = x * 2.0
+                b = x * 2.0
+            c = x * 2.0
+        assert a.name == "outer/inner/mul"
+        assert b.name == "outer/mul"
+        assert c.name == "mul"
+
+    def test_counters_are_per_scoped_path(self, df):
+        # the same op name in different scopes does NOT share a counter
+        # (reference Paths.scala keys the counter by the full path)
+        with graph():
+            x = dsl.block(df, "x")
+            with scope("s"):
+                a1 = x + 1.0
+                a2 = x + 1.0
+            b1 = x + 1.0
+        assert a1.name == "s/add"
+        assert a2.name == "s/add_1"
+        assert b1.name == "add"
+
+    def test_named_override_respects_scope(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+            with scope("s"):
+                a = (x + 1.0).named("result")
+        assert a.name == "s/result"
+
+    def test_explicit_name_at_construction(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+            with scope("s"):
+                a = dsl.apply_op(lambda v: v + 1.0, x, name="out")
+        assert a.name == "s/out"
+
+
+class TestPlaceholderNaming:
+    def test_block_uses_column_name(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+        assert x.name == "x"
+        assert dsl.bound_column(x) == "x"
+
+    def test_renamed_placeholder_keeps_column_binding(self, df):
+        with graph():
+            x = dsl.block(df, "x").named("input")
+            g = build_graph(x + 1.0)
+        assert "input" in g.placeholders
+        assert g.inputs_map["input"] == "x"
+
+    def test_constant_auto_name(self):
+        with graph():
+            c1 = dsl.constant(3.0)
+            c2 = dsl.constant(4.0)
+        assert c1.name == "constant"
+        assert c2.name == "constant_1"
+
+
+class TestNodeSummariesGolden:
+    """Textual pin of the analyzeGraphTF-analog output (the reference pins
+    NodeDef text; here the (name, kind, dtype, shape) tuples)."""
+
+    def _render(self, summaries):
+        return [
+            f"{'in' if s.is_input else 'out'} {s.name}: "
+            f"{s.scalar_type.name}{list(s.shape.dims)}"
+            for s in summaries
+        ]
+
+    def test_simple_map_graph(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+            y = (x * 2.0).named("y")
+            g = build_graph(y)
+        assert self._render(g.node_summaries()) == [
+            "in x: float64[-1]",
+            "out y: float64[-1]",
+        ]
+
+    def test_scoped_two_fetch_graph(self, df):
+        with graph():
+            x = dsl.block(df, "x")
+            with scope("stats"):
+                lo = (x - 1.0).named("lo")
+                hi = (x + 1.0).named("hi")
+            g = build_graph([lo, hi])
+        assert self._render(g.node_summaries()) == [
+            "in x: float64[-1]",
+            "out stats/lo: float64[-1]",
+            "out stats/hi: float64[-1]",
+        ]
+
+
+class TestThreadLocality:
+    def test_counters_do_not_leak_across_threads(self, df):
+        # the reference's Paths object is explicitly NOT thread-safe
+        # (Paths.scala:10-12); this DSL's state is thread-local by design
+        results = {}
+
+        def worker(tag):
+            with graph():
+                x = dsl.placeholder(np.float64, [None], name=f"x{tag}")
+                a = x + 1.0
+                b = x + 2.0
+                results[tag] = (a.name, b.name)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for tag in range(4):
+            assert results[tag] == ("add", "add_1")
