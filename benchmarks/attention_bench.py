@@ -6,10 +6,21 @@ SURVEY §5 "long context: absent"). Run on the attached backend:
     python benchmarks/attention_bench.py [seq_lens...]
 
 Prints one JSON line per (sequence length, dtype) with ms/call, achieved
-TFLOP/s, and MFU (% of the chip's matmul peak for that dtype). bf16 inputs
-run the kernel's matmuls in the MXU's native bf16 mode (f32 accumulation);
-dense attention materializes the [L, L] score matrix, flash streams K/V
-through VMEM so its memory stays O(L).
+TFLOP/s, and MFU (% of the chip's matmul peak for that dtype).
+
+Methodology — CHAIN-LENGTH DIFFERENTIAL: on a tunnel-attached chip, any
+single timed dispatch carries 0.1-0.2s of link RTT, and per-iteration
+dispatch adds host-side overhead that does NOT run on the chip; dividing
+by the iteration count leaks both into "per-call" numbers (round-3 rows
+under-reported MFU by ~20 points this way). Here each row times TWO
+single-dispatch programs that chain the op n1 and n2 times inside one
+``lax.fori_loop`` and reports (T(n2) - T(n1)) / (n2 - n1): the constant
+RTT/dispatch terms cancel exactly, leaving pure on-chip time. Chain
+lengths are sized so the compute delta is ~1.5s — far above RTT variance
+(reps take the min). bf16 inputs run the kernel's matmuls in the MXU's
+native bf16 mode (f32 accumulation); dense attention materializes the
+[L, L] score matrix, flash streams K/V through VMEM so its memory stays
+O(L).
 """
 
 import json
@@ -43,38 +54,85 @@ def _make_qkv(L, B, H, D, dtype):
     return mk(), mk(), mk()
 
 
-def bench_one(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
+def _diff_time(make_chain, args, est_per_call, target_delta_s=1.5, reps=3):
+    """(T(n2) - T(n1)) / (n2 - n1) with chains sized so the compute delta
+    dominates link noise; min over reps."""
+    delta = max(20, int(target_delta_s / max(est_per_call, 1e-6)))
+    n1 = max(5, delta // 5)
+    n2 = n1 + delta
+    f1, f2 = make_chain(n1), make_chain(n2)
+    _sync(f1(*args))
+    _sync(f2(*args))
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(f1(*args))
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _sync(f2(*args))
+        t2 = time.perf_counter() - t0
+        per = (t2 - t1) / (n2 - n1)
+        best = per if best is None else min(best, per)
+    return best, (n1, n2)
+
+
+def bench_one(L, B=4, H=8, D=64, causal=True, dtype="bfloat16",
+              block_q=None, block_k=None):
     import jax
     import jax.numpy as jnp
 
     from tensorframes_tpu.ops.attention import (
+        _best_blocks,
         attention_reference,
         flash_attention,
     )
 
     q, k, v = _make_qkv(L, B, H, D, dtype)
+    bq, bk = _best_blocks(
+        jnp.bfloat16 if dtype == "bfloat16" else jnp.float32, D, L
+    )
+    if block_q:
+        bq = block_q
+    if block_k:
+        bk = block_k
 
-    # chain the op inside ONE jitted program (output feeds the next query)
-    # so per-dispatch link latency amortizes and the chip time dominates
-    chain = 10
-
-    def chained(attn):
+    def flash_chain(n):
         def f(a, b, c):
             def body(_, acc):
-                return attn(acc, b, c).astype(a.dtype)
+                return flash_attention(
+                    acc, b, c, causal=causal, block_q=bq, block_k=bk
+                ).astype(a.dtype)
 
-            return jax.lax.fori_loop(0, chain, body, a)
+            return jax.lax.fori_loop(0, n, body, a)
 
         return jax.jit(f)
 
-    flash1 = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=causal))
+    def dense_chain(n):
+        # the carry MUST feed the op (as in flash_chain): a loop-invariant
+        # body would be hoisted by XLA and the differential would measure
+        # nothing
+        def f(a, b, c):
+            def body(_, acc):
+                return attention_reference(acc, b, c, causal=causal).astype(
+                    a.dtype
+                )
+
+            return jax.lax.fori_loop(0, n, body, a)
+
+        return jax.jit(f)
+
+    flash1 = jax.jit(
+        lambda a, b, c: flash_attention(
+            a, b, c, causal=causal, block_q=bq, block_k=bk
+        )
+    )
     dense1 = jax.jit(
         lambda a, b, c: attention_reference(a, b, c, causal=causal)
     )
-    flash = chained(lambda a, b, c: flash_attention(a, b, c, causal=causal))
 
     out_f = _sync(flash1(q, k, v))
     err = None
+    dense_ok = True
     try:
         out_d = _sync(dense1(q, k, v))
         err = float(
@@ -84,39 +142,27 @@ def bench_one(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
                 )
             )
         )
-        dense = chained(
-            lambda a, b, c: attention_reference(a, b, c, causal=causal)
-        )
-        _sync(dense(q, k, v))
     except Exception:
-        dense = None  # [L, L] score matrix no longer fits HBM
+        dense_ok = False  # [L, L] score matrix no longer fits HBM
 
-    def timeit(f):
-        _sync(f(q, k, v))
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(iters):
-            out = f(q, k, v)  # independent dispatches queue on device
-        _sync(out)
-        return (time.perf_counter() - t0) / iters / chain
-
-    tf_ = timeit(flash)
-    td = timeit(dense) if dense is not None else None
     # attention FLOPs: 2 matmuls of [L,L]x[L,D] per head (causal ~half)
     flops = 4.0 * B * H * L * L * D * (0.5 if causal else 1.0)
-    tflops = flops / tf_ / 1e12
     peak = _V5E_PEAK_FLOPS[dtype]
-    note = None
-    if tflops * 1e12 / peak < 0.10:
-        # low MFU at short L means the measured time is mostly dispatch,
-        # not kernel compute (one sync readback per iters x chain calls
-        # still leaves a per-call dispatch share on this tunneled chip;
-        # dense XLA pays the same) — the long-L rows reflect the kernel
-        note = (
-            "dispatch-dominated row (MFU < 10%): per-call overhead on "
-            "this tunneled chip exceeds the kernel's compute at this "
-            "size — the long-L rows reflect the kernel's streaming rate"
-        )
+    est = flops / (0.5 * peak)
+    tf_, chains = _diff_time(flash_chain, (q, k, v), est)
+    td = None
+    if dense_ok:
+        try:
+            # dense does 2x the causal FLOPs (no tile skipping) at lower
+            # efficiency; size its chains from a conservative estimate
+            td, _ = _diff_time(
+                dense_chain, (q, k, v),
+                (flops * (2.0 if causal else 1.0)) / (0.25 * peak),
+                target_delta_s=1.0, reps=2,
+            )
+        except Exception:
+            td = None
+    tflops = flops / tf_ / 1e12
     return {
         "metric": "flash_attention_ms",
         "seq_len": L,
@@ -125,17 +171,20 @@ def bench_one(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
         "head_dim": D,
         "causal": causal,
         "dtype": dtype,
+        "block_q": bq,
+        "block_k": bk,
         "flash_ms": round(tf_ * 1e3, 3),
         "dense_ms": round(td * 1e3, 3) if td else None,
         "speedup_vs_dense": round(td / tf_, 3) if td else None,
         "flash_tflops": round(tflops, 2),
         "mfu_pct_of_v5e_peak": round(100.0 * tflops * 1e12 / peak, 1),
         "max_abs_err_vs_dense": round(err, 6) if err is not None else None,
-        "note": note,
+        "chain_lengths": chains,
     }
 
 
-def bench_backward(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
+def bench_backward(L, B=4, H=8, D=64, causal=True, dtype="bfloat16",
+                   block_q=None, block_k=None):
     """Train-step row: fwd + FlashAttention-2 backward (the custom VJP's
     two pallas kernels), the op long-context TRAINING actually runs.
     FLOP model: fwd 1x + bwd 2.5x (dq/dk/dv matmuls + softmax tile
@@ -143,36 +192,37 @@ def bench_backward(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
     import jax
     import jax.numpy as jnp
 
-    from tensorframes_tpu.ops.attention import flash_attention
+    from tensorframes_tpu.ops.attention import _best_blocks, flash_attention
 
     q, k, v = _make_qkv(L, B, H, D, dtype)
+    bq, bk = _best_blocks(
+        jnp.bfloat16 if dtype == "bfloat16" else jnp.float32, D, L
+    )
+    if block_q:
+        bq = block_q
+    if block_k:
+        bk = block_k
 
     def loss(a, b, c):
-        return flash_attention(a, b, c, causal=causal).astype(
-            jnp.float32
-        ).sum()
+        return flash_attention(
+            a, b, c, causal=causal, block_q=bq, block_k=bk
+        ).astype(jnp.float32).sum()
 
-    # chain fwd+bwd steps inside ONE program (summing all three grads into
-    # the next query keeps dq AND dk/dv live — nothing DCEs), so dispatch
-    # latency amortizes like the forward rows
-    chain = 5
+    def chain(n):
+        # summing all three grads into the next query keeps dq AND dk/dv
+        # live — nothing DCEs
+        def f(a, b, c):
+            def body(_, acc):
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(acc, b, c)
+                return (dq + dk + dv).astype(a.dtype)
 
-    def f(a, b, c):
-        def body(_, acc):
-            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(acc, b, c)
-            return (dq + dk + dv).astype(a.dtype)
+            return jax.lax.fori_loop(0, n, body, a)
 
-        return jax.lax.fori_loop(0, chain, body, a)
+        return jax.jit(f)
 
-    g = jax.jit(f)
-    _sync(g(q, k, v))
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = g(q, k, v)
-    _sync(out)
-    dt_step = (time.perf_counter() - t0) / iters / chain
     flops = 3.5 * 4.0 * B * H * L * L * D * (0.5 if causal else 1.0)
+    peak = _V5E_PEAK_FLOPS[dtype]
+    dt_step, chains = _diff_time(chain, (q, k, v), flops / (0.4 * peak))
     return {
         "metric": "flash_attention_train_step_ms",
         "seq_len": L,
@@ -181,41 +231,152 @@ def bench_backward(L, B=4, H=8, D=64, causal=True, iters=5, dtype="bfloat16"):
         "head_dim": D,
         "causal": causal,
         "dtype": dtype,
+        "block_q": bq,
+        "block_k": bk,
         "fwd_bwd_ms": round(dt_step * 1e3, 3),
         "tflops": round(flops / dt_step / 1e12, 2),
         "mfu_pct_of_v5e_peak": round(
-            100.0 * flops / dt_step / _V5E_PEAK_FLOPS[dtype], 1
+            100.0 * flops / dt_step / peak, 1
         ),
+        "chain_lengths": chains,
+    }
+
+
+def bench_ring_hop(chunk=32768, hops=4, B=1, H=4, D=128, dtype="bfloat16"):
+    """The blockwise ring-attention hop chain at a long-context chunk
+    size, on one chip: fold ``hops`` visiting k/v chunks of ``chunk``
+    tokens through the carry-mode flash kernel exactly as an
+    ``hops``-chip ring runs per chip (hop 0 = causal diagonal, later
+    hops = fully-visible past chunks), minus only the ppermute. The
+    pre-blockwise implementation materialized a [chunk, chunk] f32 score
+    matrix per (batch, head) per hop — at this size that is
+    B*H*chunk^2*4 bytes (16 GiB at the defaults), beyond HBM; the
+    blockwise path streams tiles, so this row EXISTING is the >HBM
+    regression test. The figure of merit is the hop chain's TFLOP/s
+    relative to the single-chip flash kernel at the same chunk
+    (ring_vs_flash_pct) — the fraction of kernel throughput the ring
+    path retains."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.ops.attention import (
+        _NEG_BIG,
+        _best_blocks,
+        _finalize,
+        flash_attention,
+        flash_carry,
+    )
+
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(B * H, chunk, D)).astype(np.float32)
+    ).astype(dt)
+    qf = mk()
+    kcs = [mk() for _ in range(hops)]
+    vcs = [mk() for _ in range(hops)]
+    bq, bk = _best_blocks(dt, D, chunk)
+
+    def hop_chain(n):
+        def f(q, ks, vs):
+            def body(_, q_in):
+                m = jnp.full((B * H, chunk, 1), _NEG_BIG, jnp.float32)
+                l = jnp.zeros((B * H, chunk, 1), jnp.float32)
+                acc = jnp.zeros((B * H, chunk, D), jnp.float32)
+                # hop 0: the causal diagonal; hops 1..n-1: past chunks
+                m, l, acc = flash_carry(
+                    q_in, ks[0], vs[0], m, l, acc,
+                    causal=True, offset=0, block_q=bq, block_k=bk,
+                    interpret=False,
+                )
+                for h in range(1, hops):
+                    m, l, acc = flash_carry(
+                        q_in, ks[h], vs[h], m, l, acc,
+                        causal=False, offset=0, block_q=bq, block_k=bk,
+                        interpret=False,
+                    )
+                return _finalize(l, acc).astype(q_in.dtype)
+
+            return jax.lax.fori_loop(0, n, body, q)
+
+        return jax.jit(f)
+
+    # hop-chain FLOPs: diagonal is half-masked, the rest are full
+    flops = 4.0 * B * H * chunk * chunk * D * (0.5 + (hops - 1))
+    peak = _V5E_PEAK_FLOPS[dtype]
+    per, chains = _diff_time(
+        hop_chain, (qf, kcs, vcs), flops / (0.5 * peak)
+    )
+    hop_tflops = flops / per / 1e12
+
+    # single-chip flash reference at the same chunk + blocks
+    q4 = qf.reshape(B, H, chunk, D)
+    k4 = kcs[0].reshape(B, H, chunk, D)
+    v4 = vcs[0].reshape(B, H, chunk, D)
+
+    def flash_chain(n):
+        def f(a, b, c):
+            def body(_, acc):
+                return flash_attention(
+                    acc, b, c, causal=True, block_q=bq, block_k=bk
+                ).astype(a.dtype)
+
+            return jax.lax.fori_loop(0, n, body, a)
+
+        return jax.jit(f)
+
+    fl_flops = 4.0 * B * H * chunk * chunk * D * 0.5
+    fl_per, _ = _diff_time(
+        flash_chain, (q4, k4, v4), fl_flops / (0.5 * peak)
+    )
+    fl_tflops = fl_flops / fl_per / 1e12
+    return {
+        "metric": "ring_hop_chain_tflops",
+        "chunk_per_chip": chunk,
+        "hops": hops,
+        "batch": B,
+        "heads": H,
+        "head_dim": D,
+        "dtype": dtype,
+        "block_q": bq,
+        "block_k": bk,
+        "hop_chain_ms": round(per * 1e3, 3),
+        "hop_chain_tflops": round(hop_tflops, 2),
+        "flash_single_chip_tflops": round(fl_tflops, 2),
+        "ring_vs_flash_pct": round(100.0 * hop_tflops / fl_tflops, 1),
+        "dense_path_score_bytes": int(B * H * chunk * chunk * 4),
+        "chain_lengths": chains,
+        "note": "old dense-score ring would allocate "
+        f"{B * H * chunk * chunk * 4 / (1 << 30):.0f} GiB of scores per "
+        "hop at this size (> HBM); the blockwise path runs it",
     }
 
 
 def main():
-    lens = [int(a) for a in sys.argv[1:]] or [1024, 2048, 4096, 8192, 16384]
+    lens = [int(a) for a in sys.argv[1:]] or [8192, 16384, 32768]
     for L in lens:
         for dtype in ("bfloat16", "float32"):
             print(json.dumps(bench_one(L, dtype=dtype)))
     for L in lens:
-        if L >= 4096:
+        if L >= 8192:
             print(json.dumps(bench_backward(L)))
+    print(json.dumps(bench_ring_hop()))
 
 
 def run_all():
     """All rows as dicts (for BENCH_ALL aggregation)."""
     out = []
-    for L in (1024, 2048, 4096, 8192):
-        for dtype in ("bfloat16", "float32"):
-            out.append(bench_one(L, dtype=dtype))
-    # long-context rows where compute dominates dispatch
-    out.append(bench_one(16384, B=2, dtype="bfloat16"))
-    out.append(bench_one(32768, B=1, dtype="bfloat16"))
     # D=128 rows: the MXU's full contraction width (D=64 caps the QK and
     # PV matmuls at half the systolic array)
-    out.append(bench_one(8192, H=4, D=128, dtype="bfloat16"))
-    out.append(bench_one(32768, B=1, H=4, D=128, dtype="bfloat16"))
+    for L in (8192, 16384, 32768):
+        out.append(bench_one(L, B=1, H=4, D=128, dtype="bfloat16"))
+    out.append(bench_one(8192, B=1, H=4, D=128, dtype="float32"))
+    out.append(bench_one(16384, B=2, D=64, dtype="bfloat16"))
     # training rows: the backward pass is pallas too
-    out.append(bench_backward(8192))
-    out.append(bench_backward(16384, B=2))
-    out.append(bench_backward(16384, B=2, H=4, D=128))
+    out.append(bench_backward(16384, B=1, H=4, D=128))
+    out.append(bench_backward(32768, B=1, H=4, D=128))
+    # the blockwise ring hop chain at the >HBM chunk size
+    out.append(bench_ring_hop())
     return out
 
 

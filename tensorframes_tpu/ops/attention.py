@@ -116,6 +116,38 @@ def _lse_sentinel(m: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+#: measured-best (block_q, block_k) per (dtype kind, head_dim bucket,
+#: min seq len) on v5e, chain-differential timed (benchmarks/
+#: attention_bench.py methodology; sweep recorded in BENCH_ALL_r04.json).
+#: 1024x1024 won every measured combo — bigger tiles (2048+) exceed VMEM
+#: and fail to compile, 512-wide tiles lose 3-10% to per-tile overhead:
+#:   bf16 D=128: L=8k 118.8 TF/s, L=16k 129.3, L=32k 127.5 (vs 100-117
+#:   for 512x1024 / 1024x2048); bf16 D=64: 55-56 TF/s (half-width MXU
+#:   contraction); f32 D=128: same ordering (f32 inputs ride the MXU's
+#:   default bf16 pass, so tile behavior tracks bf16).
+#: The table keys exist so future chips/dtypes can diverge without an
+#: API change; the lookup picks the largest-L entry <= L.
+_BEST_BLOCKS = {
+    # (is_lowp, d_bucket): [(min_L, (block_q, block_k)), ...] descending
+    (True, 128): [(0, (1024, 1024))],
+    (True, 64): [(0, (1024, 1024))],
+    (False, 128): [(0, (1024, 1024))],
+    (False, 64): [(0, (1024, 1024))],
+}
+
+
+def _best_blocks(dtype, d, l):
+    """Measured-best kernel tiles for this (dtype, head_dim, L); see
+    ``_BEST_BLOCKS``. Callers may always override explicitly."""
+    is_lowp = dtype in (jnp.bfloat16, jnp.float16)
+    d_bucket = 128 if d > 64 else 64
+    rows = _BEST_BLOCKS[(is_lowp, d_bucket)]
+    for min_l, blocks in sorted(rows, reverse=True):
+        if l >= min_l:
+            return blocks
+    return rows[-1][1]
+
+
 def _check_tiles(block_q, lq, block_k, lk):
     """The public kernel entry points floor-divide the grid; a block that
     does not divide its sequence would silently drop the tail rows."""
@@ -739,8 +771,8 @@ def flash_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     causal: bool = False,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Tiled attention, [B, H, L, D] layout. DIFFERENTIABLE: a custom VJP
@@ -749,12 +781,13 @@ def flash_attention(
     per-row log-sum-exp — long-context training never materializes [L, L]
     in either direction.
 
-    Default tiles (1024x1024, clamped to the sequence) are the measured
-    best on v5e at L=8192 (the round-2 512x1024 default measured ~8pct
-    slower under an honest readback barrier) — bigger tiles amortize the
-    online-softmax rescale and keep the MXU on larger matmuls. bf16
-    inputs run the matmuls in the MXU's native bf16 mode with f32
-    accumulation (see :func:`online_block_update`), forward and backward.
+    Default tiles come from the measured-best table ``_BEST_BLOCKS``
+    (chain-differential timed per dtype/head_dim/L on v5e; 1024x1024 on
+    every current entry, clamped to the sequence) — bigger tiles amortize
+    the online-softmax rescale and keep the MXU on larger matmuls, and
+    2048+ tiles exceed VMEM. bf16 inputs run the matmuls in the MXU's
+    native bf16 mode with f32 accumulation (see
+    :func:`online_block_update`), forward and backward.
 
     One grid step owns one (query block, key block) pair; the online-softmax
     state lives in VMEM scratch across the key axis, so K/V stream through
@@ -765,6 +798,10 @@ def flash_attention(
     True off-TPU so tests run on CPU."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
+    if block_q is None or block_k is None:
+        tuned_q, tuned_k = _best_blocks(q.dtype, d, max(lq, lk))
+        block_q = block_q or tuned_q
+        block_k = block_k or tuned_k
     block_q = _fit_tile(block_q, lq)
     block_k = _fit_tile(block_k, lk)
     if block_q is None or block_k is None:
