@@ -9,9 +9,12 @@ Measurement modes (all through the full engine — capture, validation,
 schema analysis, lazy frame, thunk, dispatch):
 
 - **pipeline** (primary): N chained passes with device-resident outputs —
-  each pass's result column stays in HBM and feeds a device-side check, the
-  way chained ``map_blocks``/``reduce_blocks`` pipelines actually run. One
-  host fetch at the end forces the whole chain.
+  each pass is exactly one engine dispatch, the way chained
+  ``map_blocks``/``reduce_blocks`` pipelines actually run; every pass's
+  result column stays in HBM and ONE final fold + host fetch forces the
+  whole chain (per-pass check dispatches would charge harness overhead to
+  the engine). Footprint: all N output columns stay live until the fold
+  (~4 MB × 100 here); size iters to the output column, not just patience.
 - **host_pipelined**: every pass's full output is fetched to the host, with
   ``copy_to_host_async`` overlapping transfers against compute.
 - **host_sequential**: fetch each pass synchronously (the round-1 mode);
@@ -77,21 +80,30 @@ def main():
 
     # -- primary: device-resident chained passes ---------------------------
     @jax.jit
-    def _check(p):
-        return p.sum()
+    def _force_all(preds):
+        # one fold over EVERY pass's output: consuming all of them in a
+        # single final program guarantees completion of the whole chain
+        # regardless of execution order
+        return sum(p.sum() for p in preds)
 
     def _chained(iters, graph, frame):
-        # shared forcing discipline for every pipeline mode: accumulate a
-        # device-resident check per pass, ONE host fetch at the end
-        acc = None
+        # shared forcing discipline for every pipeline mode: each pass is
+        # exactly ONE dispatch (the engine program itself); outputs stay
+        # device-resident and a single final fold + host fetch forces the
+        # chain. Per-pass check dispatches (the r03 harness) cost one
+        # host->tunnel round per pass and were charging harness overhead
+        # to the engine. All iters outputs stay live in HBM until the
+        # fold — ~400 MB at this workload's 4 MB i32 output column.
+        outs = []
         for _ in range(iters):
             sf = map_blocks(graph, frame)
-            s = _check(sf.column_data("prediction").device())
-            acc = s if acc is None else acc + s
-        np.asarray(acc)
+            outs.append(sf.column_data("prediction").device())
+        np.asarray(_force_all(tuple(outs)))
 
-    _chained(3, g, df)  # flush: compile _check, absorb the first-sync quantum
+    # flush: compile the final fold AT THE TIMED LENGTH (it re-traces per
+    # tuple arity) and absorb the first-sync quantum
     iters = 100
+    _chained(iters, g, df)
     with timer.section("pipeline"):
         t0 = time.perf_counter()
         _chained(iters, g, df)
@@ -121,7 +133,7 @@ def main():
     )
     assert (preds_b == ref).mean() > 0.98, "bf16 scoring mismatch"
 
-    _chained(3, score_bf16, dfb)  # warmup outside the section
+    _chained(iters, score_bf16, dfb)  # warmup at the timed arity
     with timer.section("bf16_pipeline"):
         t0 = time.perf_counter()
         _chained(iters, score_bf16, dfb)
