@@ -53,6 +53,28 @@ def main():
     acc = float((cont == expect).mean())
     print(f"greedy decode follows the learned rule at {acc:.0%} (chance ~{1/vocab:.1%})")
 
+    # sampled decode: temperature + top-k + nucleus, seeds swept through
+    # ONE compiled program (seed/temperature/top_p are traced arguments)
+    for seed in (0, 1):
+        s = lm.generate(
+            prompt[:1], max_new_tokens=8,
+            temperature=0.8, seed=seed, top_k=8, top_p=0.95,
+        )
+        print(f"sampled decode (seed {seed}): {s[0, 4:].tolist()}")
+    assert len(lm._generate_cache) <= 2  # greedy + ONE sampled program
+
+    # ragged prompts: variable-length rows decode in ONE left-padded batch,
+    # each exactly as it would alone
+    from tensorframes_tpu.models import left_pad_prompts
+
+    packed, lens = left_pad_prompts(
+        [tokens[0, :2].tolist(), tokens[1, :5].tolist(), tokens[2, :3].tolist()]
+    )
+    ragged = lm.generate(packed, max_new_tokens=6, prompt_lengths=lens)
+    solo = lm.generate(tokens[1:2, :5], max_new_tokens=6)
+    np.testing.assert_array_equal(ragged[1, packed.shape[1]:], solo[0, 5:])
+    print(f"ragged batch decode matches per-row decode for lengths {lens.tolist()}")
+
     # ring attention (sequence parallelism) when a mesh is available
     n = len(jax.devices())
     if n >= 2 and seq % n == 0:
