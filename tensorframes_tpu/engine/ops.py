@@ -423,36 +423,63 @@ def map_blocks(
         fetches, dframe, cell_inputs=False, feed_dict=feed_dict,
         constants=constants, schema=schema,
     )
-    binding = validate_map_inputs(
-        g, schema, block=True, constants=set(constants or ())
-    )
-    # ragged/binary columns are rejected when blocks are materialized in the
-    # thunk (column_block raises), keeping construction metadata-only/lazy
-    _ensure_precision(g, schema)
-    input_shapes = {
-        ph: schema[col].block_shape.with_lead(Unknown)
-        for ph, col in binding.items()
-    }
-    out_specs = g.analyze(input_shapes)
-    for name, spec in out_specs.items():
-        if spec.shape.num_dims == 0:
-            raise InvalidDimensionError(
-                f"map_blocks output {name!r} is a scalar; map outputs must "
-                f"keep the leading row dimension (use reduce_blocks to "
-                f"reduce a frame to one row)"
-            )
-    if not trim:
-        check_output_collisions(out_specs, dframe.schema)
+    # the validate/analyze/result-schema prologue depends only on
+    # (graph, schema, trim, constant names) — memoize it on the graph so
+    # chained passes over the same frame (the pipeline steady state) pay
+    # a dict lookup, not a re-derivation. Keys hold the schema objects
+    # themselves, so an id() collision after GC cannot alias. Decoder
+    # passes rebuild their probe schema per call and naturally miss.
+    plan_key = (id(schema), id(dframe.schema), trim,
+                tuple(sorted(constants or ())))
+    plan_cache = getattr(g, "_map_plan_cache", None)
+    if plan_cache is None:
+        from collections import OrderedDict
 
-    fetch_names = sorted(out_specs)  # outputs sorted by name (reference)
-    fetch_infos = [
-        _fetch_column_info(n, out_specs[n], block_output=True)
-        for n in fetch_names
-    ]
-    if trim:
-        result_info = FrameInfo(fetch_infos)
+        plan_cache = g._map_plan_cache = OrderedDict()
+    hit = plan_cache.get(plan_key)
+    if hit is not None and hit[0] is schema and hit[1] is dframe.schema:
+        _, _, binding, out_specs, fetch_names, result_info = hit
     else:
-        result_info = FrameInfo(fetch_infos + list(dframe.schema))
+        binding = validate_map_inputs(
+            g, schema, block=True, constants=set(constants or ())
+        )
+        # ragged/binary columns are rejected when blocks are materialized
+        # in the thunk (column_block raises), keeping construction
+        # metadata-only/lazy
+        _ensure_precision(g, schema)
+        input_shapes = {
+            ph: schema[col].block_shape.with_lead(Unknown)
+            for ph, col in binding.items()
+        }
+        out_specs = g.analyze(input_shapes)
+        for name, spec in out_specs.items():
+            if spec.shape.num_dims == 0:
+                raise InvalidDimensionError(
+                    f"map_blocks output {name!r} is a scalar; map outputs "
+                    f"must keep the leading row dimension (use "
+                    f"reduce_blocks to reduce a frame to one row)"
+                )
+        if not trim:
+            check_output_collisions(out_specs, dframe.schema)
+
+        fetch_names = sorted(out_specs)  # outputs sorted by name (ref)
+        fetch_infos = [
+            _fetch_column_info(n, out_specs[n], block_output=True)
+            for n in fetch_names
+        ]
+        if trim:
+            result_info = FrameInfo(fetch_infos)
+        else:
+            result_info = FrameInfo(fetch_infos + list(dframe.schema))
+        # decoder passes rebuild their probe schema per call, so their
+        # entries could never hit — don't insert them
+        if not decode_fns:
+            while len(plan_cache) >= 64:  # bound; evict oldest
+                plan_cache.popitem(last=False)
+            plan_cache[plan_key] = (
+                schema, dframe.schema, binding, out_specs, fetch_names,
+                result_info,
+            )
 
     jit_fn = _jitted(g)
     parent = dframe
