@@ -438,6 +438,7 @@ def map_blocks(
         plan_cache = g._map_plan_cache = OrderedDict()
     hit = plan_cache.get(plan_key)
     if hit is not None and hit[0] is schema and hit[1] is dframe.schema:
+        plan_cache.move_to_end(plan_key)  # LRU, like _generate_cache
         _, _, binding, out_specs, fetch_names, result_info = hit
     else:
         binding = validate_map_inputs(

@@ -34,6 +34,13 @@ __all__ = [
 ]
 
 _NEG_BIG = -0.7 * float(np.finfo(np.float32).max)  # mask value; exp() == 0
+#: softmax runs in BASE 2 internally: s is pre-scaled by log2(e) (folded
+#: into the existing qk scale multiply, so it costs nothing) and the
+#: exponentials are bare exp2 — jnp.exp lowers to exp2(x * log2e) on TPU,
+#: so this removes one full-tile VPU multiply per score element. The
+#: probabilities 2^(s*log2e - m2) == e^(s - m) are IDENTICAL; only the
+#: internal m/l/lse state lives in the scaled domain.
+_LOG2E = float(np.log2(np.e))
 #: log-sum-exp sentinel for rows that attend to nothing (causal with more
 #: queries than keys): exp(s - _POS_BIG) underflows to exactly 0 for any
 #: finite score, so the backward recomputation gives those rows p == 0
@@ -69,6 +76,10 @@ def online_block_update(
     Pallas kernel and the ring step so single-chip and distributed paths
     compute identically.
 
+    The running max ``m`` lives in the BASE-2 domain (scores pre-scaled
+    by log2(e); see ``_LOG2E``) — ``l``, ``acc``, and the finalized
+    output are identical to the natural-base formulation.
+
     MXU precision follows the INPUT dtype: bf16/f16 q/k/v keep their
     matmuls in that dtype (the MXU's native high-rate mode; v5e runs bf16
     at ~4x its f32 rate) with ``preferred_element_type=f32`` so
@@ -80,16 +91,16 @@ def online_block_update(
         k.astype(mxu_dt),
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale
+    ) * (scale * _LOG2E)
     if mask is not None:
         s = jnp.where(mask, s, _NEG_BIG)
     m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-    # rows still fully masked keep m == _NEG_BIG; exp(s - m) would be
-    # exp(0) = 1 for masked entries, so re-mask p explicitly
-    p = jnp.exp(s - m_new)
+    # rows still fully masked keep m == _NEG_BIG; exp2(s - m) would be
+    # exp2(0) = 1 for masked entries, so re-mask p explicitly
+    p = jnp.exp2(s - m_new)
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
-    alpha = jnp.exp(m - m_new)
+    alpha = jnp.exp2(m - m_new)
     l_new = alpha * l + p.sum(axis=-1, keepdims=True)
     pv_dt = _mxu_dtype(v.dtype)
     acc_new = alpha * acc + jax.lax.dot_general(
@@ -106,13 +117,16 @@ def _finalize(l: jnp.ndarray, acc: jnp.ndarray) -> jnp.ndarray:
 
 
 def _lse_sentinel(m: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
-    """Per-row log-sum-exp saved for the backward, with the ``_POS_BIG``
-    sentinel on rows that attended to nothing (so the backward recomputes
-    p == 0 and zero gradient there). The single source of this convention
-    — the flash kernel's emit and the ring forward both use it; the
-    backward's empty-row guarantee depends on them being bit-identical."""
+    """Per-row log-sum-exp saved for the backward — in the BASE-2 domain
+    (``m`` is the base-2 running max, so this is ``log2(sum exp)``;
+    ``_bwd_tile_terms`` recomputes p with exp2 against it) — with the
+    ``_POS_BIG`` sentinel on rows that attended to nothing (so the
+    backward recomputes p == 0 and zero gradient there). The single
+    source of this convention — the flash kernel's emit and the ring
+    forward both use it; the backward's empty-row guarantee depends on
+    them being bit-identical."""
     return jnp.where(
-        l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _POS_BIG
+        l > 0.0, m + jnp.log2(jnp.maximum(l, 1e-30)), _POS_BIG
     )
 
 
@@ -457,7 +471,8 @@ def flash_carry(
 def _bwd_tile_terms(q, kj, vj, do, lse, dlt, scale, mask):
     """Shared per-tile recomputation for both backward kernels: softmax
     probabilities ``p`` and score gradient ``ds`` for one (q, k) tile pair.
-    ``lse``/``dlt`` are [bq, 1]; fully-masked rows carry the ``_POS_BIG``
+    ``lse``/``dlt`` are [bq, 1] (``lse`` in the base-2 domain, matching
+    :func:`_lse_sentinel`); fully-masked rows carry the ``_POS_BIG``
     lse sentinel, so ``p`` (and with it every gradient term) is exactly 0
     there. f32 throughout except the matmuls, which keep the input's MXU
     mode (bf16 tiles run the backward at the chip's high rate, like the
@@ -468,10 +483,10 @@ def _bwd_tile_terms(q, kj, vj, do, lse, dlt, scale, mask):
         kj.astype(mxu_dt),
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale
+    ) * (scale * _LOG2E)
     if mask is not None:
         s = jnp.where(mask, s, _NEG_BIG)
-    p = jnp.exp(s - lse)  # masked / empty-row entries underflow to 0
+    p = jnp.exp2(s - lse)  # masked / empty-row entries underflow to 0
     dp = jax.lax.dot_general(
         do.astype(mxu_dt),
         vj.astype(mxu_dt),
