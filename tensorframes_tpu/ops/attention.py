@@ -138,7 +138,9 @@ def _lse_sentinel(m: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
 #:   bf16 D=128: L=8k 118.8 TF/s, L=16k 129.3, L=32k 127.5 (vs 100-117
 #:   for 512x1024 / 1024x2048); bf16 D=64: 55-56 TF/s (half-width MXU
 #:   contraction); f32 D=128: same ordering (f32 inputs ride the MXU's
-#:   default bf16 pass, so tile behavior tracks bf16).
+#:   default bf16 pass, so tile behavior tracks bf16). Sweep predates
+#:   the base-2 softmax (which lifted all rows ~4-6% uniformly; tile
+#:   ordering unchanged).
 #: The table keys exist so future chips/dtypes can diverge without an
 #: API change; the lookup picks the largest-L entry <= L.
 _BEST_BLOCKS = {
