@@ -43,14 +43,30 @@ def init_transformer(
     max_len: int = 128,
     d_ff: Optional[int] = None,
     moe_experts: Optional[int] = None,
+    n_kv_heads: Optional[int] = None,
     dtype=np.float32,
 ) -> Params:
     """``moe_experts``: replace every block's dense MLP with a top-1
     routed mixture of that many experts (:mod:`..parallel.moe`); the
-    expert slabs shard over an ``ep`` mesh axis at apply time."""
+    expert slabs shard over an ``ep`` mesh axis at apply time.
+
+    ``n_kv_heads``: grouped-query attention (GQA) — k/v get this many
+    heads (must divide ``n_heads``; default = ``n_heads`` = standard
+    MHA, ``1`` = MQA), each shared by ``n_heads / n_kv_heads`` query
+    heads. The fused qkv projection shrinks to
+    ``[d, d + 2 * n_kv_heads * head_dim]`` and the decode KV cache
+    holds only ``n_kv_heads`` heads — the cache (usually the decode
+    memory ceiling) shrinks by the group factor."""
     if d_model % n_heads:
         raise ValueError(f"d_model {d_model} must divide by n_heads {n_heads}")
+    n_kv_heads = n_heads if n_kv_heads is None else n_kv_heads
+    if n_kv_heads < 1 or n_heads % n_kv_heads:
+        raise ValueError(
+            f"n_heads {n_heads} must divide by n_kv_heads {n_kv_heads} "
+            f"(>= 1)"
+        )
     d_ff = d_ff or 4 * d_model
+    kv_d = (d_model // n_heads) * n_kv_heads
     rng = np.random.default_rng(seed)
 
     def dense(fan_in, fan_out):
@@ -61,12 +77,16 @@ def init_transformer(
         "pos": (rng.normal(0, 0.02, (max_len, d_model))).astype(dtype),
         "blocks": [],
         "ln_f": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
+        # n_kv_heads is NOT stored: it is derivable from the qkv weight's
+        # static column count (see _kv_heads), so every site that strips
+        # the one non-array entry ("n_heads") before device_put stays
+        # unchanged and old checkpoints load as plain MHA
         "n_heads": n_heads,
     }
     for li in range(n_layers):
         block = {
             "ln1": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
-            "qkv": dense(d_model, 3 * d_model),
+            "qkv": dense(d_model, d_model + 2 * kv_d),
             "proj": dense(d_model, d_model),
             "ln2": {"g": np.ones(d_model, dtype), "b": np.zeros(d_model, dtype)},
         }
@@ -94,6 +114,14 @@ def _ln(x, p):
     return (x - mu) / jnp.sqrt(var + 1e-5) * p["g"] + p["b"]
 
 
+def _kv_heads(block, d_model: int, n_heads: int) -> int:
+    """GQA group count from the qkv weight's STATIC shape: columns are
+    ``d + 2 * n_kv * head_dim``, so ``n_kv`` needs no extra stored
+    metadata (plain MHA weights give ``n_kv == n_heads``)."""
+    kv_d = (int(np.shape(block["qkv"])[1]) - d_model) // 2
+    return kv_d // (d_model // n_heads)
+
+
 def _attention(x, block, n_heads, causal, attn_impl, mesh, batch_axis=None):
     import jax.numpy as jnp
 
@@ -106,13 +134,24 @@ def _attention(x, block, n_heads, causal, attn_impl, mesh, batch_axis=None):
 
     bsz, length, d = x.shape
     hd = d // n_heads
-    qkv = x @ block["qkv"]  # [B, L, 3D]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    n_kv = _kv_heads(block, d, n_heads)
+    kv_d = n_kv * hd
+    qkv = x @ block["qkv"]  # [B, L, D + 2*kv_d]
+    q, k, v = jnp.split(qkv, [d, d + kv_d], axis=-1)
 
-    def heads(t):  # [B, L, D] -> [B, H, L, hd]
-        return t.reshape(bsz, length, n_heads, hd).transpose(0, 2, 1, 3)
+    def heads(t, h):  # [B, L, h*hd] -> [B, h, L, hd]
+        return t.reshape(bsz, length, h, hd).transpose(0, 2, 1, 3)
 
-    q, k, v = heads(q), heads(k), heads(v)
+    q = heads(q, n_heads)
+    k = heads(k, n_kv)
+    v = heads(v, n_kv)
+    if n_kv != n_heads:
+        # grouped-query: each k/v head serves n_heads/n_kv query heads.
+        # The repeat materializes full-H k/v for the compute path (the
+        # kernels are head-uniform); the GQA saving is in the weights and
+        # the decode KV cache, which store only n_kv heads.
+        k = jnp.repeat(k, n_heads // n_kv, axis=1)
+        v = jnp.repeat(v, n_heads // n_kv, axis=1)
     if attn_impl == "ring":
         o = ring_attention(
             q, k, v, mesh=mesh, causal=causal, batch_axis=batch_axis
@@ -382,7 +421,12 @@ def transformer_generate(
     else:
         offsets = plen - jnp.asarray(prompt_lengths, dtype=jnp.int32)
 
-    k0 = jnp.zeros((len(blocks), bsz, n_heads, total, hd), jnp.float32)
+    # GQA: the cache stores only the model's n_kv k/v heads — the decode
+    # memory ceiling shrinks by the group factor (n_kv == n_heads for MHA)
+    n_kv = _kv_heads(blocks[0], d_model, n_heads)
+    group = n_heads // n_kv
+    kv_d = n_kv * hd
+    k0 = jnp.zeros((len(blocks), bsz, n_kv, total, hd), jnp.float32)
     v0 = jnp.zeros_like(k0)
 
     def step(carry, t):
@@ -405,22 +449,25 @@ def transformer_generate(
         for li, block in enumerate(blocks):
             x = _ln(h, block["ln1"])
             qkv = x @ jnp.asarray(block["qkv"])
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            q = q.reshape(bsz, n_heads, hd)
+            q, k, v = jnp.split(qkv, [d_model, d_model + kv_d], axis=-1)
+            # grouped-query layout: q [B, n_kv, g, hd] against a cache
+            # holding only n_kv k/v heads (g = 1 and n_kv = n_heads for
+            # plain MHA — same math, same program shape)
+            q = q.reshape(bsz, n_kv, group, hd)
             kc = jax.lax.dynamic_update_slice(
                 kc,
-                k.reshape(1, bsz, n_heads, 1, hd),
+                k.reshape(1, bsz, n_kv, 1, hd),
                 (li, 0, 0, t, 0),
             )
             vc = jax.lax.dynamic_update_slice(
                 vc,
-                v.reshape(1, bsz, n_heads, 1, hd),
+                v.reshape(1, bsz, n_kv, 1, hd),
                 (li, 0, 0, t, 0),
             )
-            s = jnp.einsum("bhd,bhtd->bht", q, kc[li]) * scale
-            s = jnp.where(visible[:, None, :], s, neg)
+            s = jnp.einsum("bkgd,bktd->bkgt", q, kc[li]) * scale
+            s = jnp.where(visible[:, None, None, :], s, neg)
             att = jnp.einsum(
-                "bht,bhtd->bhd", jax.nn.softmax(s, axis=-1), vc[li]
+                "bkgt,bktd->bkgd", jax.nn.softmax(s, axis=-1), vc[li]
             ).reshape(bsz, d_model)
             h = h + att @ jnp.asarray(block["proj"])
             hx = _ln(h, block["ln2"])
@@ -622,12 +669,14 @@ class TransformerLM:
         the single-device step: losses match :meth:`fit` to float
         tolerance.
 
-        The FUSED ``qkv`` matrix ([D, q|k|v]) is also output-sharded, but
-        its tp cuts land at multiples of ``3*d_model/tp`` — across the
-        q/k/v segment boundaries — so GSPMD inserts a reshard between the
-        qkv matmul and the head split rather than the zero-comm Megatron
-        column pattern (that would need per-third sharding, i.e. separate
-        q/k/v parameters). proj/up/down realize the classic pattern.
+        The FUSED ``qkv`` matrix ([D, q|k|v], width ``d + 2*kv_d`` —
+        ``3*d_model`` for plain MHA, smaller under GQA) is also
+        output-sharded, but its tp cuts land at equal fractions of the
+        fused width — across the q/k/v segment boundaries — so GSPMD
+        inserts a reshard between the qkv matmul and the head split
+        rather than the zero-comm Megatron column pattern (that would
+        need per-segment sharding, i.e. separate q/k/v parameters).
+        proj/up/down realize the classic pattern.
 
         Constraints: batch divisible by dp, ``n_heads`` and ``d_ff``
         divisible by tp (the head einsums partition on head boundaries).

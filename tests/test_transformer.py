@@ -477,3 +477,111 @@ class TestMoETransformer:
         )
         assert np.asarray(logits).shape == (2, 16, 16)
         assert float(aux) > 0
+
+
+class TestGQA:
+    """Grouped-query attention: n_kv_heads k/v heads shared by
+    n_heads/n_kv_heads query heads each. Exact oracle: an MHA model whose
+    k/v projection columns are the GQA weights repeated per group
+    computes identical attention."""
+
+    def _mha_twin(self, params, n_heads, n_kv):
+        import copy
+
+        d = params["embed"].shape[1]
+        hd = d // n_heads
+        g = n_heads // n_kv
+        twin = copy.deepcopy(params)
+        for block in twin["blocks"]:
+            w = np.asarray(block["qkv"])
+            wq, wk, wv = w[:, :d], w[:, d:d + n_kv * hd], w[:, d + n_kv * hd:]
+            rep = lambda m: np.repeat(
+                m.reshape(d, n_kv, hd), g, axis=1
+            ).reshape(d, d)
+            block["qkv"] = np.concatenate([wq, rep(wk), rep(wv)], axis=1)
+        return twin
+
+    def test_logits_match_repeated_weight_mha(self):
+        rng = np.random.default_rng(0)
+        lm = TransformerLM.init(
+            1, 32, d_model=32, n_heads=8, n_layers=2, max_len=16,
+            n_kv_heads=2,
+        )
+        toks = rng.integers(0, 32, size=(3, 12)).astype(np.int32)
+        got = transformer_logits(lm.params, toks)
+        twin = self._mha_twin(lm.params, 8, 2)
+        want = transformer_logits(twin, toks)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_mqa_single_kv_head(self):
+        rng = np.random.default_rng(1)
+        lm = TransformerLM.init(
+            2, 16, d_model=16, n_heads=4, max_len=16, n_kv_heads=1
+        )
+        toks = rng.integers(0, 16, size=(2, 8)).astype(np.int32)
+        got = transformer_logits(lm.params, toks)
+        want = transformer_logits(self._mha_twin(lm.params, 4, 1), toks)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_generate_matches_naive_recompute(self):
+        # the GQA decode path (n_kv-head cache, grouped einsums) must
+        # agree with the full forward on the growing sequence
+        rng = np.random.default_rng(2)
+        lm = TransformerLM.init(
+            3, 24, d_model=32, n_heads=8, n_layers=2, max_len=20,
+            n_kv_heads=2,
+        )
+        prompt = rng.integers(0, 24, size=(2, 4)).astype(np.int32)
+        got = lm.generate(prompt, max_new_tokens=8)
+        toks = prompt
+        for _ in range(8):
+            logits = transformer_logits(lm.params, jnp.asarray(toks))
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            toks = np.concatenate([toks, nxt[:, None].astype(np.int32)], 1)
+        np.testing.assert_array_equal(got, toks)
+
+    def test_gqa_trains(self):
+        rng = np.random.default_rng(3)
+        lm = TransformerLM.init(
+            4, 16, d_model=16, n_heads=4, max_len=12, n_kv_heads=2
+        )
+        toks = rng.integers(0, 16, size=(4, 10)).astype(np.int32)
+        losses = lm.fit(toks, steps=4, lr=0.3)
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    def test_qkv_weight_shrinks(self):
+        lm = TransformerLM.init(
+            0, 16, d_model=32, n_heads=8, max_len=8, n_kv_heads=2
+        )
+        # d + 2 * n_kv * hd = 32 + 2*2*4 = 48, vs 96 for MHA
+        assert lm.params["blocks"][0]["qkv"].shape == (32, 48)
+        mha = TransformerLM.init(0, 16, d_model=32, n_heads=8, max_len=8)
+        assert mha.params["blocks"][0]["qkv"].shape == (32, 96)
+
+    def test_indivisible_kv_heads_rejected(self):
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            TransformerLM.init(
+                0, 16, d_model=32, n_heads=8, max_len=8, n_kv_heads=3
+            )
+
+    def test_gqa_through_ring_and_ulysses(self):
+        rng = np.random.default_rng(5)
+        lm = TransformerLM.init(
+            6, 24, d_model=32, n_heads=8, n_layers=1, max_len=16,
+            n_kv_heads=2,
+        )
+        toks = rng.integers(0, 24, size=(2, 16)).astype(np.int32)
+        dense = transformer_logits(lm.params, toks)
+        mesh = make_mesh({"sp": 4})
+        for impl in ("ring", "ulysses"):
+            got = transformer_logits(
+                lm.params, toks, attn_impl=impl, mesh=mesh
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(dense), rtol=2e-4, atol=2e-4,
+                err_msg=impl,
+            )
