@@ -48,9 +48,20 @@ def _numpy_baseline(x, w, b, iters=3):
 
 
 def main():
+    import glob
+    import os
+
     import jax
 
     import tensorframes_tpu as tft
+
+    # persistent-compile-cache state BEFORE any compilation: entries > 0
+    # means this process warm-starts from executables earlier processes
+    # compiled (the round-5 cold-start fix — see docs/perf.md)
+    cache_dir = tft.enable_compilation_cache()
+    cache_entries_before = (
+        len(glob.glob(os.path.join(cache_dir, "*"))) if cache_dir else 0
+    )
     from tensorframes_tpu.engine import map_blocks
     from tensorframes_tpu.models import MLPClassifier
     from tensorframes_tpu.utils.profiling import Timer
@@ -69,7 +80,18 @@ def main():
         df = tft.TensorFrame.from_columns({"features": x}).analyze()
     g = clf._scoring_graph(df, "features", "prediction", None)
 
-    # warmup (compile + first transfer) and correctness check
+    # the three cold-start costs, accounted separately because they have
+    # different owners: UPLOAD is workload data movement over the tunnel
+    # (the reference pays the same shuffle to feed its sessions — and it
+    # recurs per process regardless of caching), PRECOMPILE is XLA
+    # compilation (eliminated for warm processes by the persistent cache,
+    # round-5 fix — compare this section cold vs warm), and
+    # warmup+verify is the first real pass + correctness check.
+    with timer.section("upload"):
+        feat_dev = df.column_data("features").device()
+        np.asarray(feat_dev.ravel()[:1])  # force the transfer (advisory sync)
+    with timer.section("precompile"):
+        tft.precompile(g, df)
     with timer.section("warmup+verify"):
         scored = map_blocks(g, df)
         preds = np.asarray(scored.column_data("prediction").host())
@@ -207,6 +229,17 @@ def main():
                     },
                     "sections": {
                         k: round(v, 4) for k, v in timer.totals.items()
+                    },
+                    # workload data movement — recurs per process, cache-
+                    # INDEPENDENT (a real TPU host moves the same bytes
+                    # over PCIe at ~10 GB/s; this is the tunnel)
+                    "upload_gb_per_s": round(
+                        x.nbytes / 1e9 / timer.totals["upload"], 3
+                    ),
+                    "compilation_cache": {
+                        "dir": cache_dir,
+                        "entries_at_start": cache_entries_before,
+                        "warm_start": cache_entries_before > 0,
                     },
                 },
             }

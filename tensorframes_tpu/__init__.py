@@ -25,10 +25,19 @@ print_schema, block, row``.
 
 __version__ = "0.1.0"
 
+from .utils.config import enable_compilation_cache
+
+# the reference pays zero compile cost (TF 1.x sessions run GraphDefs
+# directly); the persistent XLA cache is this framework's equivalent —
+# fresh processes reload compiled executables instead of recompiling.
+# Opt out with TFT_NO_COMPILE_CACHE=1.
+enable_compilation_cache()
+
 from .schema import Shape, Unknown
 from .frame import TensorFrame, GroupedFrame, Row
 from .engine import (
     map_blocks,
+    precompile,
     map_rows,
     reduce_blocks,
     reduce_rows,
@@ -64,6 +73,8 @@ from . import schema, utils
 __all__ = [
     # the reference's nine public functions (core.py:11-12)
     "map_blocks",
+    "precompile",
+    "enable_compilation_cache",
     "map_rows",
     "reduce_blocks",
     "reduce_rows",

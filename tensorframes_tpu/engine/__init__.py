@@ -2,6 +2,7 @@
 
 from .ops import (
     map_blocks,
+    precompile,
     map_rows,
     reduce_blocks,
     reduce_rows,
@@ -21,6 +22,7 @@ from .validation import (
 
 __all__ = [
     "map_blocks",
+    "precompile",
     "map_rows",
     "reduce_blocks",
     "reduce_rows",

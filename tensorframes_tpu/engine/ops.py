@@ -649,18 +649,23 @@ def map_blocks(
 
         def _recover_lost_partitions() -> int:
             """Probe every partition's result; re-run the poisoned ones.
-            Returns how many were recovered."""
+            Returns how many were recovered. EVERY fetch column is probed
+            — an async failure can poison a single output buffer of a
+            multi-output program, and probing only the first fetch would
+            miss it (re-raising the original error instead of recovering)."""
             recovered = 0
             for idx in range(len(piece_part)):
-                probe = pieces[fetch_names[0]][idx]
-                try:
-                    if hasattr(probe, "block_until_ready"):
-                        probe.block_until_ready()
-                    else:
-                        np.asarray(probe)
-                except Exception:
-                    _recover_piece(idx)
-                    recovered += 1
+                for nm in fetch_names:
+                    probe = pieces[nm][idx]
+                    try:
+                        if hasattr(probe, "block_until_ready"):
+                            probe.block_until_ready()
+                        else:
+                            np.asarray(probe)
+                    except Exception:
+                        _recover_piece(idx)  # re-runs ALL fetches for idx
+                        recovered += 1
+                        break
             return recovered
 
         try:
@@ -764,6 +769,93 @@ def map_blocks(
     return TensorFrame(
         {}, result_info, num_partitions=parent.num_partitions, _thunk=thunk
     )
+
+
+def precompile(
+    fetches,
+    frame_or_schema,
+    *,
+    block_rows: Optional[Sequence[int]] = None,
+    feed_dict: Optional[Dict[str, str]] = None,
+    constants: Optional[Dict[str, Any]] = None,
+) -> int:
+    """Ahead-of-time compile the block programs a ``map_blocks`` call would
+    dispatch, without moving any data.
+
+    The reference never needed this — a TF 1.x session executes a GraphDef
+    with zero compile cost (``TensorFlowOps.scala:76-95``) — but XLA
+    compiles per (program, block shape), and on a fresh process that
+    compile lands on the first data pass. With the persistent compilation
+    cache (:func:`tensorframes_tpu.utils.enable_compilation_cache`, on by
+    default) this both *warms* the on-disk cache and lets a serving
+    process front-load all compilation before traffic:
+
+    - pass a :class:`TensorFrame` and the partition block shapes are
+      derived from it (one program per distinct partition size);
+    - pass a :class:`FrameInfo` (e.g. for a graph loaded from an artifact
+      via ``load_graph`` in a process that has no data yet) together with
+      ``block_rows``, the partition sizes you will serve.
+
+    Returns the number of distinct programs compiled. Compilation results
+    land in XLA's in-process and persistent caches; the first real
+    ``map_blocks`` pass then pays only executable-cache lookup.
+    """
+    import jax
+
+    if isinstance(frame_or_schema, TensorFrame):
+        df, schema = frame_or_schema, frame_or_schema.schema
+        if block_rows is None:
+            block_rows = [
+                hi - lo for lo, hi in df.partition_bounds() if hi > lo
+            ]
+    elif isinstance(frame_or_schema, FrameInfo):
+        df, schema = None, frame_or_schema
+        if block_rows is None:
+            raise ValueError(
+                "precompile(schema) needs block_rows= (the partition sizes "
+                "to compile for); pass a TensorFrame to derive them"
+            )
+    else:
+        raise TypeError(
+            f"frame_or_schema must be a TensorFrame or FrameInfo; got "
+            f"{type(frame_or_schema).__name__}"
+        )
+    g = _as_graph(
+        fetches, df, cell_inputs=False, feed_dict=feed_dict,
+        constants=constants, schema=schema,
+    )
+    binding = validate_map_inputs(
+        g, schema, block=True, constants=set(constants or ())
+    )
+    _ensure_precision(g, schema)
+    for ph, col in binding.items():
+        cell = schema[col].cell_shape
+        if any(d == Unknown for d in cell.dims):
+            raise ValueError(
+                f"cannot precompile: column {col!r} has unknown cell "
+                f"dims {cell}; analyze() the frame (or supply an analyzed "
+                f"schema) first"
+            )
+    const_specs = {
+        ph: jax.ShapeDtypeStruct(
+            np.asarray(v).shape, np.asarray(v).dtype
+        )
+        for ph, v in (constants or {}).items()
+    }
+    jit_fn = _jitted(g)
+    compiled = 0
+    for n in sorted(set(block_rows)):
+        feed = {
+            ph: jax.ShapeDtypeStruct(
+                (n, *schema[col].cell_shape.dims),
+                schema[col].scalar_type.np_dtype,
+            )
+            for ph, col in binding.items()
+        }
+        feed.update(const_specs)
+        jit_fn.lower(feed).compile()
+        compiled += 1
+    return compiled
 
 
 # ---------------------------------------------------------------------------

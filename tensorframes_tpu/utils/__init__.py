@@ -1,6 +1,12 @@
 """Utilities: logging, config, profiling hooks."""
 
-from .config import Config, get_config, set_config, ensure_x64
+from .config import (
+    Config,
+    get_config,
+    set_config,
+    ensure_x64,
+    enable_compilation_cache,
+)
 from .logging import get_logger
 from .failures import DeviceOOMError, is_oom, is_transient, run_with_retries
 from . import profiling
@@ -10,6 +16,7 @@ __all__ = [
     "get_config",
     "set_config",
     "ensure_x64",
+    "enable_compilation_cache",
     "get_logger",
     "DeviceOOMError",
     "is_oom",

@@ -9,9 +9,16 @@ consumes them.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 
-__all__ = ["Config", "get_config", "set_config", "ensure_x64"]
+__all__ = [
+    "Config",
+    "get_config",
+    "set_config",
+    "ensure_x64",
+    "enable_compilation_cache",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +62,77 @@ def set_config(**kwargs) -> Config:
     with _lock:
         _config = dataclasses.replace(_config, **kwargs)
     return _config
+
+
+_cache_enabled_dir: "str | None" = None
+
+
+def enable_compilation_cache(
+    path: "str | None" = None,
+    *,
+    min_compile_time_secs: float = 0.1,
+    min_entry_size_bytes: int = -1,
+) -> "str | None":
+    """Point XLA's persistent compilation cache at a disk directory.
+
+    The reference pays zero compile cost — a TF 1.x session executes its
+    GraphDef immediately (``TensorFlowOps.scala:76-95``) — while every
+    fresh JAX process re-traces and re-compiles each program from scratch
+    (~100 s of warmup on the headline bench). With this cache enabled,
+    compiles are keyed on (HLO, compile options, backend) and serialized
+    executables are reloaded by later processes, so a fresh process pays
+    only deserialization (<1 s per program) instead of compilation.
+
+    Called automatically on ``import tensorframes_tpu`` (opt out with
+    ``TFT_NO_COMPILE_CACHE=1``). Idempotent; returns the cache dir in use,
+    or ``None`` when disabled. Precedence for the directory:
+
+    1. explicit ``path`` argument
+    2. ``TFT_COMPILE_CACHE_DIR`` environment variable
+    3. ``JAX_COMPILATION_CACHE_DIR`` (jax's own knob — left untouched)
+    4. ``~/.cache/tensorframes_tpu/xla-cache``
+
+    ``min_compile_time_secs`` (default 0.1 s, vs jax's 1.0 s) caches even
+    small programs: engine passes dispatch many sub-second-compile thunks
+    (fold programs, vmap buckets) whose re-compiles dominate short-job
+    warmup. ``min_entry_size_bytes=-1`` removes the size floor for the
+    same reason. Entries are content-addressed, so a shared directory is
+    safe across concurrent processes.
+    """
+    global _cache_enabled_dir
+    if os.environ.get("TFT_NO_COMPILE_CACHE", "") not in ("", "0"):
+        return None
+    with _lock:
+        if _cache_enabled_dir is not None:
+            return _cache_enabled_dir
+        import jax
+
+        if path is None:
+            path = os.environ.get("TFT_COMPILE_CACHE_DIR")
+        if path is None and os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            # the user already configured jax directly; respect it
+            _cache_enabled_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
+            return _cache_enabled_dir
+        if path is None:
+            path = os.path.join(
+                os.path.expanduser("~"), ".cache", "tensorframes_tpu",
+                "xla-cache",
+            )
+        try:
+            os.makedirs(path, exist_ok=True)
+        except OSError:  # read-only HOME (hermetic CI): run uncached
+            return None
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            min_compile_time_secs,
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes",
+            min_entry_size_bytes,
+        )
+        _cache_enabled_dir = path
+        return path
 
 
 _x64_done = False
