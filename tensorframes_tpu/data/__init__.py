@@ -1,5 +1,6 @@
-"""Host data plane: native packing kernels + ragged buffers."""
+"""Host data plane: native packing kernels, ragged buffers, binary codecs."""
 
+from .codecs import decode_image, encode_image, image_decoder
 from .packer import (
     native_available,
     pad_ragged,
@@ -11,6 +12,9 @@ from .packer import (
 from .ragged import RaggedBuffer
 
 __all__ = [
+    "decode_image",
+    "encode_image",
+    "image_decoder",
     "native_available",
     "pad_ragged",
     "unpad_ragged",

@@ -1,0 +1,68 @@
+"""Host-side binary codecs for ``map_blocks(decoders=)`` / ``decode_column``.
+
+The reference's image workload reads files with ``sc.binaryFiles`` and
+decodes inside the TF graph with ``tf.image.decode_jpeg`` + resize
+(``read_image.py:80-87``). On TPU, image decode is host work — XLA has no
+byte-stream ops, and shipping raw encoded bytes to the chip would waste
+link bandwidth — so codecs run on the engine's decode thread pool, several
+partitions ahead of the device (``engine/ops.py`` decoder prefetch), which
+is the same decode-overlaps-compute schedule the reference got from
+Spark's partition iterator feeding the session.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["decode_image", "encode_image", "image_decoder"]
+
+
+def decode_image(
+    raw: bytes,
+    resize_hw: Optional[Tuple[int, int]] = None,
+    channels: int = 3,
+) -> np.ndarray:
+    """Decode PNG/JPEG/... bytes to a uint8 HWC array (the parity op for
+    the reference's ``decode_jpeg`` + ``resize_images`` stage). Grayscale
+    and RGBA inputs are converted to ``channels``; ``resize_hw`` uses
+    bilinear, like the reference's default ``resize_images``."""
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(raw))
+    img = img.convert({1: "L", 3: "RGB", 4: "RGBA"}[channels])
+    if resize_hw is not None:
+        h, w = resize_hw
+        img = img.resize((w, h), Image.BILINEAR)  # PIL takes (W, H)
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def image_decoder(
+    resize_hw: Optional[Tuple[int, int]] = None, channels: int = 3
+):
+    """A ``bytes -> array`` codec closure for ``decoders=`` with the
+    resize/channel policy bound in (decoders are probed on row 0 and must
+    produce one uniform shape — fix it here, not per image)."""
+
+    def decode(raw: bytes) -> np.ndarray:
+        return decode_image(raw, resize_hw=resize_hw, channels=channels)
+
+    return decode
+
+
+def encode_image(arr: np.ndarray, format: str = "PNG") -> bytes:
+    """uint8 HWC array -> encoded bytes (test/e2e helper; PNG round-trips
+    losslessly, so decode(encode(x)) == x exactly)."""
+    from PIL import Image
+
+    arr = np.asarray(arr, dtype=np.uint8)
+    if arr.ndim == 3 and arr.shape[2] == 1:
+        arr = arr[:, :, 0]
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format=format)
+    return buf.getvalue()

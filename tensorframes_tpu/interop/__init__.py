@@ -8,6 +8,15 @@ Arrow record batches (SURVEY §2.4).
 
 from .arrow import from_arrow, to_arrow
 from .spark import from_spark, to_spark, spark_available
+from .weights import (
+    load_weights,
+    save_weights,
+    flatten_tree,
+    unflatten_tree,
+    torch_conv_kernel,
+    torch_linear_kernel,
+    cnn_params_from_torch_state,
+)
 
 __all__ = [
     "from_arrow",
@@ -15,4 +24,11 @@ __all__ = [
     "from_spark",
     "to_spark",
     "spark_available",
+    "load_weights",
+    "save_weights",
+    "flatten_tree",
+    "unflatten_tree",
+    "torch_conv_kernel",
+    "torch_linear_kernel",
+    "cnn_params_from_torch_state",
 ]

@@ -1,0 +1,266 @@
+"""Import externally-produced model weights into scoring programs.
+
+The reference's flagship production workload scores a REAL pre-trained
+frozen VGG-16: it downloads a published checkpoint, freezes the variables
+into the GraphDef (``convert_variables_to_constants``, reference
+``core.py:41-55``), and runs that frozen graph over binary image rows
+(``read_image.py:29-55,147-167``). The TPU-native equivalent: load a
+published weight file (``.npz`` or ``.safetensors`` — the formats real
+model hubs publish), convert it to a param pytree, and close a JAX scoring
+function over it — tracing bakes the arrays into the XLA program as
+constants, which is exactly the freezing step, and ``save_graph`` then
+serializes the frozen program as a deployable artifact.
+
+Layout conversion is the real work. Torch models are NCHW with OIHW conv
+kernels and ``[out, in]`` linear weights; XLA:TPU wants NHWC/HWIO (the
+layout it tiles onto the MXU — see ``models/cnn.py``). Kernels transpose
+cleanly, but the first dense layer after a flatten is order-sensitive:
+torch flattens ``C*H*W``, NHWC flattens ``H*W*C``, so that matrix's input
+axis must be re-ordered, not just transposed. :func:`cnn_params_from_torch_state`
+does all of this for VGG-style stacks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "load_weights",
+    "save_weights",
+    "flatten_tree",
+    "unflatten_tree",
+    "torch_conv_kernel",
+    "torch_linear_kernel",
+    "cnn_params_from_torch_state",
+]
+
+
+def load_weights(path: str) -> Dict[str, np.ndarray]:
+    """Load a flat ``name -> array`` weight dict from ``.npz`` or
+    ``.safetensors`` (chosen by extension). The analog of the reference
+    downloading a published checkpoint (``read_image.py:29-44``)."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".npz":
+        with np.load(path) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    if ext == ".safetensors":
+        from safetensors.numpy import load_file
+
+        return dict(load_file(path))
+    raise ValueError(
+        f"unsupported weight format {ext!r} (expected .npz or .safetensors)"
+    )
+
+
+def save_weights(path: str, weights: Dict[str, Any]) -> None:
+    """Write a flat or nested weight dict to ``.npz`` / ``.safetensors``.
+    Nested pytrees are flattened with dotted names (see
+    :func:`flatten_tree`), the convention both formats' ecosystems use."""
+    flat = {
+        k: np.ascontiguousarray(np.asarray(v))
+        for k, v in flatten_tree(weights).items()
+    }
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".npz":
+        np.savez(path, **flat)
+        return
+    if ext == ".safetensors":
+        from safetensors.numpy import save_file
+
+        save_file(flat, path)
+        return
+    raise ValueError(
+        f"unsupported weight format {ext!r} (expected .npz or .safetensors)"
+    )
+
+
+def flatten_tree(tree: Any, sep: str = ".", _prefix: str = "") -> Dict[str, Any]:
+    """Nested dict/list pytree -> flat dotted-name dict (lists index as
+    ``name.0``, ``name.1``, ... — the torch ``state_dict`` convention)."""
+    if isinstance(tree, dict):
+        items = tree.items()
+    elif isinstance(tree, (list, tuple)):
+        items = ((str(i), v) for i, v in enumerate(tree))
+    else:
+        return {_prefix.rstrip(sep): tree}
+    out: Dict[str, Any] = {}
+    for k, v in items:
+        out.update(flatten_tree(v, sep=sep, _prefix=f"{_prefix}{k}{sep}"))
+    return out
+
+
+def unflatten_tree(flat: Dict[str, Any], sep: str = ".") -> Any:
+    """Inverse of :func:`flatten_tree`: dotted names -> nested dicts, with
+    runs of contiguous integer keys ``0..n-1`` becoming lists."""
+    nested: Dict[str, Any] = {}
+    for name, v in flat.items():
+        parts = name.split(sep)
+        d = nested
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+
+    def listify(d):
+        if not isinstance(d, dict):
+            return d
+        d = {k: listify(v) for k, v in d.items()}
+        if d and all(k.isdigit() for k in d):
+            idx = sorted(int(k) for k in d)
+            if idx == list(range(len(idx))):
+                return [d[str(i)] for i in idx]
+        return d
+
+    return listify(nested)
+
+
+def torch_conv_kernel(w: np.ndarray) -> np.ndarray:
+    """Torch ``Conv2d.weight`` ``[O, I, kH, kW]`` -> XLA HWIO
+    ``[kH, kW, I, O]``."""
+    w = np.asarray(w)
+    if w.ndim != 4:
+        raise ValueError(f"conv kernel must be 4-D, got shape {w.shape}")
+    return np.ascontiguousarray(w.transpose(2, 3, 1, 0))
+
+
+def torch_linear_kernel(w: np.ndarray) -> np.ndarray:
+    """Torch ``Linear.weight`` ``[out, in]`` -> matmul-ready ``[in, out]``."""
+    w = np.asarray(w)
+    if w.ndim != 2:
+        raise ValueError(f"linear kernel must be 2-D, got shape {w.shape}")
+    return np.ascontiguousarray(w.T)
+
+
+def torch_flatten_linear_kernel(
+    w: np.ndarray, chw: Tuple[int, int, int]
+) -> np.ndarray:
+    """Convert the dense layer that directly follows a flatten.
+
+    Torch flattens NCHW activations to ``C*H*W`` order; NHWC flattens to
+    ``H*W*C``. A plain transpose of ``[out, C*H*W]`` would silently wire
+    every unit to the wrong pixels — the import would "work" and score
+    garbage. Re-order the input axis: ``[out, C, H, W]`` -> ``[H, W, C,
+    out]`` -> ``[H*W*C, out]``."""
+    c, h, w_ = chw
+    w = np.asarray(w)
+    if w.ndim != 2 or w.shape[1] != c * h * w_:
+        raise ValueError(
+            f"flatten-linear weight {w.shape} does not match C*H*W="
+            f"{c}*{h}*{w_}={c * h * w_}"
+        )
+    return np.ascontiguousarray(
+        w.reshape(w.shape[0], c, h, w_).transpose(2, 3, 1, 0).reshape(
+            h * w_ * c, w.shape[0]
+        )
+    )
+
+
+def cnn_params_from_torch_state(
+    state: Dict[str, np.ndarray],
+    input_hw: Tuple[int, int],
+    channels: int,
+    convs_per_block: int = 2,
+) -> Dict[str, Any]:
+    """Torch ``state_dict`` of a VGG-style stack -> :mod:`~tensorframes_tpu.models.cnn`
+    params (the pytree :func:`~tensorframes_tpu.models.cnn.cnn_embed`
+    scores with).
+
+    Expected publisher architecture (the standard torch Sequential VGG
+    pattern, matching the reference's VGG-16 shape): 3x3 ``Conv2d``
+    (padding=1) + ReLU layers, a 2x2 ``MaxPool2d`` after every
+    ``convs_per_block`` convs, flatten, then one or two ``Linear`` layers
+    (embedding head, optional classifier head). ``weight``/``bias``
+    tensors pair by their shared module prefix, and modules order by
+    NATURAL name sort — not dict order, which ``.safetensors`` does not
+    preserve (it sorts keys, putting ``10.weight`` before ``2.weight``).
+    Every 4-D weight is a conv, every 2-D weight a linear; the first
+    linear gets the NCHW->NHWC flatten re-ordering (see
+    :func:`torch_flatten_linear_kernel`), using the post-conv spatial
+    size derived from ``input_hw`` and the pool count.
+    """
+    import re
+
+    def natural(s: str):
+        return [
+            int(t) if t.isdigit() else t for t in re.split(r"(\d+)", s)
+        ]
+
+    groups: Dict[str, Dict[str, np.ndarray]] = {}
+    for name, arr in state.items():
+        prefix, _, leaf = name.rpartition(".")
+        groups.setdefault(prefix, {})[leaf] = np.asarray(arr)
+
+    convs: List[Dict[str, np.ndarray]] = []
+    linears: List[Tuple[np.ndarray, np.ndarray]] = []
+    for prefix in sorted(groups, key=natural):
+        g = groups[prefix]
+        if "weight" not in g:
+            raise ValueError(
+                f"module {prefix!r} has {sorted(g)} but no 'weight'"
+            )
+        w = g["weight"]
+        b = g.get("bias")
+        if w.ndim == 4:
+            if b is None:
+                b = np.zeros(w.shape[0], dtype=w.dtype)
+            convs.append(
+                {"k": torch_conv_kernel(w), "b": b.astype(w.dtype)}
+            )
+        elif w.ndim == 2:
+            if b is None:
+                b = np.zeros(w.shape[0], dtype=w.dtype)
+            linears.append((w, b))
+        else:
+            raise ValueError(
+                f"unexpected {w.ndim}-D weight at module {prefix!r}"
+            )
+    if not convs or not linears:
+        raise ValueError(
+            f"need conv and linear layers; got {len(convs)} convs, "
+            f"{len(linears)} linears"
+        )
+    if len(convs) % convs_per_block:
+        raise ValueError(
+            f"{len(convs)} convs do not group into blocks of "
+            f"{convs_per_block}"
+        )
+    h, w = input_hw
+    n_pools = len(convs) // convs_per_block
+    h_out, w_out = h >> n_pools, w >> n_pools
+    if h_out < 1 or w_out < 1 or h % (1 << n_pools) or w % (1 << n_pools):
+        raise ValueError(
+            f"input {input_hw} does not survive {n_pools} 2x2 pools"
+        )
+    c_out = convs[-1]["k"].shape[-1]
+    ew, eb = linears[0]
+    params: Dict[str, Any] = {
+        "convs": convs,
+        "convs_per_block": convs_per_block,
+        "embed": {
+            "w": torch_flatten_linear_kernel(ew, (c_out, h_out, w_out)),
+            "b": np.asarray(eb, dtype=ew.dtype),
+        },
+    }
+    if len(linears) > 1:
+        hw_, hb = linears[1]
+        params["head"] = {
+            "w": torch_linear_kernel(hw_),
+            "b": np.asarray(hb, dtype=hw_.dtype),
+        }
+    if len(linears) > 2:
+        raise ValueError(
+            f"expected at most 2 linear layers (embed + head); got "
+            f"{len(linears)}"
+        )
+    # sanity: conv chain must be channel-consistent and start at the image
+    c_in = channels
+    for i, cv in enumerate(convs):
+        if cv["k"].shape[2] != c_in:
+            raise ValueError(
+                f"conv {i} expects {cv['k'].shape[2]} input channels, "
+                f"chain provides {c_in}"
+            )
+        c_in = cv["k"].shape[-1]
+    return params

@@ -126,10 +126,16 @@ class CNNScorer:
     of one Session.run per row.
     """
 
-    def __init__(self, params: Params, input_hw=(32, 32), channels=3):
+    def __init__(
+        self, params: Params, input_hw=(32, 32), channels=3, codec=None
+    ):
         self.params = params
         self.input_hw = tuple(input_hw)
         self.channels = channels
+        #: bytes -> uint8 HWC array; defaults to the raw-packed-bytes
+        #: stand-in. Pass ``tensorframes_tpu.data.image_decoder(...)`` for
+        #: real PNG/JPEG rows (the reference's decode_jpeg stage).
+        self._codec = codec
         # graph capture and compiled programs are memoized by FUNCTION
         # IDENTITY; a fresh embed closure per score_frame call would
         # re-capture (and re-run the concrete probe) every pass
@@ -143,10 +149,78 @@ class CNNScorer:
             channels=channels,
         )
 
+    @staticmethod
+    def from_pretrained(
+        path: str,
+        input_hw: Tuple[int, int],
+        channels: int = 3,
+        convs_per_block: Optional[int] = None,
+        layout: str = "torch",
+        image_format: str = "encoded",
+    ) -> "CNNScorer":
+        """Load externally-published weights into a frozen scorer — the
+        reference's download-VGG-then-freeze flow (``read_image.py:29-55``
+        + ``core.py:41-55``) as one constructor.
+
+        ``layout="torch"`` converts a torch ``state_dict`` (NCHW/OIHW,
+        ``[out,in]`` linears, C*H*W flatten) via
+        :func:`~tensorframes_tpu.interop.cnn_params_from_torch_state`;
+        ``layout="native"`` loads a :func:`flatten_tree`-saved params
+        pytree verbatim — its SAVED ``convs_per_block`` is the
+        architecture of record and wins over the argument (which only
+        fills in for checkpoints that lack it). ``image_format="encoded"``
+        wires a real PNG/JPEG codec (with bilinear resize to
+        ``input_hw``); ``"raw"`` keeps the packed-bytes stand-in."""
+        from ..interop.weights import (
+            cnn_params_from_torch_state,
+            load_weights,
+            unflatten_tree,
+        )
+
+        flat = load_weights(path)
+        if layout == "torch":
+            params = cnn_params_from_torch_state(
+                flat, input_hw, channels,
+                convs_per_block=(
+                    2 if convs_per_block is None else convs_per_block
+                ),
+            )
+        elif layout == "native":
+            params = unflatten_tree(flat)
+            if "convs_per_block" in params:
+                # saved as a 0-d array by npz/safetensors; the model code
+                # needs the plain int back
+                params["convs_per_block"] = int(
+                    np.asarray(params["convs_per_block"])
+                )
+            elif convs_per_block is not None:
+                params["convs_per_block"] = convs_per_block
+            else:
+                raise ValueError(
+                    "native checkpoint lacks convs_per_block; pass it "
+                    "explicitly"
+                )
+        else:
+            raise ValueError(f"layout must be 'torch' or 'native', got {layout!r}")
+        codec = None
+        if image_format == "encoded":
+            from ..data.codecs import image_decoder
+
+            codec = image_decoder(resize_hw=input_hw, channels=channels)
+        elif image_format != "raw":
+            raise ValueError(
+                f"image_format must be 'encoded' or 'raw', got {image_format!r}"
+            )
+        return CNNScorer(
+            params, input_hw=input_hw, channels=channels, codec=codec
+        )
+
     def decode(self, raw: bytes) -> np.ndarray:
-        """Raw packed uint8 HWC bytes -> image array (stand-in codec; real
-        deployments plug jpeg decode etc. into ``decode_column`` the same
-        way)."""
+        """Binary cell -> uint8 HWC image, via the configured codec (real
+        PNG/JPEG decode for ``from_pretrained(image_format="encoded")``
+        scorers, raw packed bytes otherwise)."""
+        if self._codec is not None:
+            return self._codec(raw)
         h, w = self.input_hw
         return np.frombuffer(raw, dtype=np.uint8).reshape(h, w, self.channels)
 

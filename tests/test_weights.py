@@ -1,0 +1,195 @@
+"""Pretrained-weight import: published checkpoint -> frozen TPU scoring.
+
+The reference's flagship binary workload downloads a REAL pre-trained
+VGG-16, freezes it, and scores images through the frame ops
+(``read_image.py:29-55,147-167``). These tests pin the TPU-native
+equivalent end to end: a torch "publisher" model's ``state_dict`` saved to
+``.safetensors``/``.npz`` is imported (NCHW/OIHW -> NHWC/HWIO, flatten
+re-ordering), scored through ``map_blocks(decoders=)`` over REAL encoded
+PNG rows, and matched against the torch model itself as the oracle.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu.data import decode_image, encode_image, image_decoder
+from tensorframes_tpu.interop import (
+    cnn_params_from_torch_state,
+    flatten_tree,
+    load_weights,
+    save_weights,
+    unflatten_tree,
+)
+from tensorframes_tpu.models import CNNScorer
+from tensorframes_tpu.models.cnn import cnn_embed
+
+HW, C = (16, 16), 3
+
+
+def _publisher_model(seed=0, embed_dim=32):
+    """The external model: a standard torch Sequential VGG-ette (2 blocks
+    of 2 convs + pool), the architecture convention
+    ``cnn_params_from_torch_state`` documents. Tests that score against
+    it importorskip torch INDIVIDUALLY — the format/codec/conversion
+    tests have no torch dependency and must run even where torch is
+    absent (CI installs only the [test] extra)."""
+    torch = pytest.importorskip("torch")
+    torch.manual_seed(seed)
+    m = torch.nn.Sequential(
+        torch.nn.Conv2d(C, 8, 3, padding=1), torch.nn.ReLU(),
+        torch.nn.Conv2d(8, 8, 3, padding=1), torch.nn.ReLU(),
+        torch.nn.MaxPool2d(2),
+        torch.nn.Conv2d(8, 16, 3, padding=1), torch.nn.ReLU(),
+        torch.nn.Conv2d(16, 16, 3, padding=1), torch.nn.ReLU(),
+        torch.nn.MaxPool2d(2),
+        torch.nn.Flatten(),
+        torch.nn.Linear(16 * (HW[0] // 4) * (HW[1] // 4), embed_dim),
+    )
+    m.eval()
+    return m
+
+
+def _torch_embed(model, images_u8):
+    """Oracle: the publisher model scoring the same uint8 HWC images."""
+    import torch  # callers built `model` via _publisher_model's skip
+
+    x = torch.from_numpy(
+        images_u8.astype(np.float32).transpose(0, 3, 1, 2) / 255.0
+    )
+    with torch.no_grad():
+        return model(x).numpy()
+
+
+def _images(n=12, seed=1):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(n, *HW, C), dtype=np.uint8)
+
+
+# --------------------------------------------------------------------------
+# formats
+
+
+def test_weight_formats_round_trip(tmp_path):
+    tree = {
+        "convs": [{"k": np.ones((3, 3, 3, 8), np.float32)}],
+        "embed": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+    }
+    for ext in ("npz", "safetensors"):
+        p = str(tmp_path / f"w.{ext}")
+        save_weights(p, tree)
+        back = unflatten_tree(load_weights(p))
+        np.testing.assert_array_equal(
+            back["convs"][0]["k"], tree["convs"][0]["k"]
+        )
+        np.testing.assert_array_equal(back["embed"]["w"], tree["embed"]["w"])
+
+
+def test_flatten_unflatten_inverse():
+    tree = {"a": {"b": [np.zeros(1), np.ones(2)]}, "c": np.full(3, 7.0)}
+    flat = flatten_tree(tree)
+    assert set(flat) == {"a.b.0", "a.b.1", "c"}
+    back = unflatten_tree(flat)
+    assert isinstance(back["a"]["b"], list)
+    np.testing.assert_array_equal(back["a"]["b"][1], np.ones(2))
+
+
+def test_load_rejects_unknown_extension(tmp_path):
+    with pytest.raises(ValueError, match="unsupported weight format"):
+        load_weights(str(tmp_path / "w.bin"))
+
+
+# --------------------------------------------------------------------------
+# torch layout conversion
+
+
+def test_torch_import_matches_torch_oracle(tmp_path):
+    """The crux: imported weights score IDENTICALLY (f32 tolerance) to the
+    torch model — including the NCHW->NHWC flatten re-ordering, which a
+    naive transpose gets silently wrong."""
+    model = _publisher_model()
+    p = str(tmp_path / "published.safetensors")
+    from safetensors.torch import save_file
+
+    save_file(model.state_dict(), p)
+
+    params = cnn_params_from_torch_state(
+        load_weights(p), input_hw=HW, channels=C, convs_per_block=2
+    )
+    imgs = _images()
+    ours = np.asarray(cnn_embed(params, imgs))
+    oracle = _torch_embed(model, imgs)
+    np.testing.assert_allclose(ours, oracle, rtol=1e-4, atol=1e-4)
+
+
+def test_torch_import_order_is_name_natural_not_dict_order(tmp_path):
+    """safetensors sorts keys, so '10.weight' < '2.weight' in dict order;
+    the importer must order by natural module index or deep stacks wire
+    layers out of sequence."""
+    model = _publisher_model()
+    sd = model.state_dict()
+    shuffled = dict(sorted(sd.items()))  # alphabetical: 10 before 2
+    params = cnn_params_from_torch_state(
+        {k: v.numpy() for k, v in shuffled.items()},
+        input_hw=HW,
+        channels=C,
+        convs_per_block=2,
+    )
+    imgs = _images(4)
+    np.testing.assert_allclose(
+        np.asarray(cnn_embed(params, imgs)),
+        _torch_embed(model, imgs),
+        rtol=1e-4,
+        atol=1e-4,
+    )
+
+
+def test_torch_import_validates_channel_chain():
+    state = {
+        "0.weight": np.zeros((8, 4, 3, 3), np.float32),  # expects 4 ch
+        "0.bias": np.zeros(8, np.float32),
+        "1.weight": np.zeros((8, 8, 3, 3), np.float32),
+        "1.bias": np.zeros(8, np.float32),
+        "2.weight": np.zeros((5, 8 * 8 * 8), np.float32),
+        "2.bias": np.zeros(5, np.float32),
+    }
+    with pytest.raises(ValueError, match="input channels"):
+        cnn_params_from_torch_state(state, (16, 16), channels=3)
+
+
+# --------------------------------------------------------------------------
+# real image codec
+
+
+def test_png_codec_round_trip():
+    img = _images(1)[0]
+    assert decode_image(encode_image(img)).tolist() == img.tolist()
+
+
+def test_image_decoder_resizes_and_converts():
+    img = _images(1, seed=3)[0]
+    dec = image_decoder(resize_hw=(8, 8), channels=1)
+    out = dec(encode_image(img))
+    assert out.shape == (8, 8, 1) and out.dtype == np.uint8
+
+
+# --------------------------------------------------------------------------
+# end to end: published weights + encoded images through the frame ops
+
+
+def test_from_pretrained_scores_real_images_via_map_blocks(tmp_path):
+    model = _publisher_model()
+    p = str(tmp_path / "published.npz")
+    np.savez(p, **{k: v.numpy() for k, v in model.state_dict().items()})
+
+    scorer = CNNScorer.from_pretrained(
+        p, input_hw=HW, channels=C, convs_per_block=2
+    )
+    imgs = _images(10)
+    raws = [encode_image(im) for im in imgs]  # REAL PNG bytes rows
+    df = tft.TensorFrame.from_columns({"image_data": raws}, num_partitions=3)
+
+    out = scorer.score_frame(df, "image_data", compute_dtype=None)
+    emb = np.asarray(out.column_data("embedding").host())
+    oracle = _torch_embed(model, imgs)  # PNG is lossless: same pixels
+    np.testing.assert_allclose(emb, oracle, rtol=1e-4, atol=1e-4)
