@@ -321,8 +321,12 @@ def filter_logits(logits, top_k: int = 0, top_p=1.0):
 
     neg = jnp.finfo(jnp.float32).min * 0.7
     if top_k and top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, neg, logits)
+        # top_k >= vocab keeps everything (lax.top_k would fail the trace
+        # with an opaque XLA shape error instead)
+        k = min(int(top_k), logits.shape[-1])
+        if k < logits.shape[-1]:
+            kth = jax.lax.top_k(logits, k)[0][..., -1:]
+            logits = jnp.where(logits < kth, neg, logits)
     if top_p is not None and not (
         _is_concrete_scalar(top_p) and top_p >= 1.0
     ):
@@ -585,12 +589,20 @@ class TransformerLM:
         return TransformerLM(init_transformer(seed, vocab, **kw))
 
     def _sgd_loop(
-        self, tokens, steps, lr, loss_kwargs, jit_kwargs=None, place=None
+        self, tokens, steps, lr, loss_kwargs, jit_kwargs=None, place=None,
+        resume=None, checkpoint_every=None, on_step=None,
+        place_restored=None,
     ):
         """Shared SGD machinery for :meth:`fit` and :meth:`fit_sharded`:
         jitted value_and_grad step, loop, params reassembly. ``loss_kwargs``
         feed :func:`transformer_loss`; ``jit_kwargs`` (e.g. out_shardings)
-        configure the jit; ``place`` maps host tokens to device."""
+        configure the jit; ``place`` maps host tokens to device.
+
+        ``resume``/``checkpoint_every``/``on_step``: same auto-resume
+        contract as :meth:`ShardedSGDTrainer.fit <..parallel.training.ShardedSGDTrainer.fit>`
+        — restore the latest step-numbered checkpoint from ``resume`` and
+        continue, write every ``checkpoint_every`` steps and at the end
+        (the reference rode Spark's task retry instead, SURVEY §5)."""
         import jax
 
         static = self.params["n_heads"]
@@ -609,10 +621,17 @@ class TransformerLM:
         toks = np.asarray(tokens, dtype=np.int32)
         if place is not None:
             toks = place(toks)
-        losses = []
-        for _ in range(steps):
-            p, loss = step(p, toks)
-            losses.append(float(loss))
+        from ..utils.checkpoint import run_checkpointed_loop
+
+        p, losses = run_checkpointed_loop(
+            lambda p_: step(p_, toks),
+            p,
+            steps,
+            resume=resume,
+            checkpoint_every=checkpoint_every,
+            on_step=on_step,
+            place_restored=place_restored,
+        )
         self.params = {**jax.device_get(p), "n_heads": static}
         return losses
 
@@ -627,6 +646,9 @@ class TransformerLM:
         moe_impl: str = "masked",
         attn_impl: str = "reference",
         remat: bool = False,
+        resume=None,
+        checkpoint_every=None,
+        on_step=None,
     ):
         """Jitted SGD on next-token loss. Single chip by default; pass a
         mesh with an ``ep`` axis to train MoE blocks expert-parallel
@@ -634,7 +656,9 @@ class TransformerLM:
         all-to-all), with ``moe_aux_weight`` adding the load-balancing
         loss. ``attn_impl="flash"`` trains through the pallas kernel's
         custom VJP (long context on one chip without the [L, L] matrix);
-        sequence-parallel training lives in :meth:`fit_sharded`."""
+        sequence-parallel training lives in :meth:`fit_sharded`.
+        ``resume``/``checkpoint_every``/``on_step``: auto-resume from a
+        checkpoint directory (see :meth:`_sgd_loop`)."""
         kw = {}
         if mesh is not None:
             kw["mesh"] = mesh
@@ -648,7 +672,11 @@ class TransformerLM:
             kw["attn_impl"] = attn_impl
         if remat:
             kw["remat"] = True
-        return self._sgd_loop(tokens, steps, lr, loss_kwargs=kw)
+        return self._sgd_loop(
+            tokens, steps, lr, loss_kwargs=kw,
+            resume=resume, checkpoint_every=checkpoint_every,
+            on_step=on_step,
+        )
 
     def fit_tp(
         self,
@@ -656,6 +684,9 @@ class TransformerLM:
         mesh,
         steps: int = 10,
         lr: float = 0.1,
+        resume=None,
+        checkpoint_every=None,
+        on_step=None,
     ):
         """One jitted SGD step over a ``dp x tp`` mesh: batch rows sharded
         over ``dp``, every block's weights Megatron-sharded over ``tp`` —
@@ -697,6 +728,20 @@ class TransformerLM:
                 f"n_heads {n_heads} must divide by tp={tp} so the "
                 f"column-parallel split lands on head boundaries"
             )
+        d_model = int(np.shape(self.params["embed"])[1])
+        for bl in self.params["blocks"]:
+            n_kv = _kv_heads(bl, d_model, n_heads)
+            if n_kv % tp:
+                # with fewer kv heads than tp shards the k/v einsums
+                # cannot partition on head boundaries and GSPMD silently
+                # replicates/reshards k/v, eroding the Megatron pattern
+                # (correct, but with extra collectives) — reject rather
+                # than quietly train slow
+                raise ValueError(
+                    f"n_kv_heads {n_kv} must divide by tp={tp}: the k/v "
+                    f"head einsums partition on kv-head boundaries (use "
+                    f"tp <= n_kv_heads, or MHA weights)"
+                )
         b = tokens.shape[0]
         if b % mesh.shape["dp"]:
             raise ValueError(
@@ -744,6 +789,12 @@ class TransformerLM:
                 out_shardings=(pshard, NamedSharding(mesh, P())),
             ),
             place=lambda t: jax.device_put(t, tok_sh),
+            resume=resume,
+            checkpoint_every=checkpoint_every,
+            on_step=on_step,
+            # restored leaves come back committed to one device; re-pin
+            # them to the Megatron plan before the sharded step sees them
+            place_restored=lambda p_: jax.device_put(p_, pshard),
         )
 
     def fit_sharded(
@@ -753,6 +804,9 @@ class TransformerLM:
         steps: int = 10,
         lr: float = 0.1,
         attn_impl: str = "ring",
+        resume=None,
+        checkpoint_every=None,
+        on_step=None,
     ):
         """One jitted SGD step over a ``dp x sp`` mesh: batch rows sharded
         over ``dp``, attention sequence-parallel over ``sp`` — ``"ring"``
@@ -802,6 +856,14 @@ class TransformerLM:
             place=lambda t: jax.device_put(
                 t, NamedSharding(mesh, P("dp", None))
             ),
+            resume=resume,
+            checkpoint_every=checkpoint_every,
+            on_step=on_step,
+            # params are replicated in this plan; re-pin restored
+            # committed leaves so the dp/sp step sees one device set
+            place_restored=lambda p_: jax.tree.map(
+                lambda a: jax.device_put(a, rep), p_
+            ),
         )
 
     def fit_pipelined(
@@ -813,10 +875,16 @@ class TransformerLM:
         n_micro: int = 4,
         schedule: str = "1f1b",
         grad_accum: int = 1,
+        resume=None,
+        checkpoint_every=None,
+        on_step=None,
     ):
         """SGD with the transformer BLOCKS pipelined over the mesh's ``pp``
         axis (one block per chip), composed with data parallelism when the
         mesh has a ``dp`` axis (microbatch rows sharded over it).
+        ``resume``/``checkpoint_every``/``on_step``: auto-resume from a
+        checkpoint directory (see :meth:`_sgd_loop`) — the checkpointed
+        tree is the PIPELINE layout (stacked, ``pp``-sharded blocks).
 
         The embedding runs outside the pipeline and trains through the
         returned input cotangent; the loss head (final norm + tied
@@ -915,10 +983,36 @@ class TransformerLM:
             return new_p, loss * inv
 
         step = jax.jit(step)
-        losses = []
-        for _ in range(steps):
-            p, loss = step(p, toks)
-            losses.append(float(loss))
+
+        def place_restored(p_):
+            # restored leaves come back COMMITTED to a single device;
+            # re-establish the pipeline placement (stacked slab over pp,
+            # everything else replicated) or the jitted step sees mixed
+            # device sets and refuses to compile
+            rep = NamedSharding(mesh, P())
+            return {
+                "stacked": jax.device_put(
+                    p_["stacked"], NamedSharding(mesh, P("pp"))
+                ),
+                **{
+                    k: jax.tree.map(
+                        lambda a: jax.device_put(a, rep), p_[k]
+                    )
+                    for k in ("embed", "pos", "ln_f")
+                },
+            }
+
+        from ..utils.checkpoint import run_checkpointed_loop
+
+        p, losses = run_checkpointed_loop(
+            lambda p_: step(p_, toks),
+            p,
+            steps,
+            resume=resume,
+            checkpoint_every=checkpoint_every,
+            on_step=on_step,
+            place_restored=place_restored,
+        )
         host = jax.device_get(p)
         n_layers = len(blocks)
         self.params = {

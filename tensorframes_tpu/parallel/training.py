@@ -152,6 +152,7 @@ class ShardedSGDTrainer:
         seed: int = 0,
         resume: Optional[str] = None,
         checkpoint_every: Optional[int] = None,
+        on_step: Optional[Callable[[int, float], None]] = None,
     ) -> Tuple[Params, List[float]]:
         """Run SGD for ``steps`` steps.
 
@@ -160,45 +161,21 @@ class ShardedSGDTrainer:
         this trainer's param plan — and training continues from that step;
         new checkpoints are written every ``checkpoint_every`` steps and at
         the end, so a killed run picks up where it left off (the reference
-        has no trainable-state persistence at all, SURVEY §5)."""
-        mgr = None
-        start = 0
-        if checkpoint_every and resume is None:
-            raise ValueError(
-                "checkpoint_every requires a checkpoint directory: pass "
-                "resume=<dir> (it is used for both writing and resuming)"
-            )
-        if resume is not None:
-            from ..utils.checkpoint import CheckpointManager
+        rode Spark's task retry instead, SURVEY §5; the process-death drill
+        in ``tests/test_multihost.py`` exercises exactly this path).
 
-            mgr = CheckpointManager(resume)
-            template = (
-                params if params is not None else self.init_params(seed)
-            )
-            ck_step, restored = mgr.restore_latest(template=template)
-            if ck_step is not None:
-                start, params = int(ck_step), restored
-            else:
-                params = template
+        ``on_step(step_number, loss)`` fires after every completed step —
+        metrics hooks, and the failure-injection point for the drill."""
+        from ..utils.checkpoint import run_checkpointed_loop
+
         params = params if params is not None else self.init_params(seed)
         xd, yd = self.place_batch(x, y)
         step = self.train_step()
-        losses = []
-        try:
-            for i in range(start, steps):
-                params, loss = step(params, xd, yd)
-                losses.append(float(loss))
-                done = i + 1
-                if (
-                    mgr is not None
-                    and checkpoint_every
-                    and done % checkpoint_every == 0
-                ):
-                    mgr.save(done, params)
-            if mgr is not None and steps > start:
-                if mgr.latest_step() != steps:
-                    mgr.save(steps, params)
-        finally:
-            if mgr is not None:
-                mgr.close()
-        return params, losses
+        return run_checkpointed_loop(
+            lambda p: step(p, xd, yd),
+            params,
+            steps,
+            resume=resume,
+            checkpoint_every=checkpoint_every,
+            on_step=on_step,
+        )
