@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-multihost bench bench-all bench-attention dryrun install lint
+.PHONY: test test-fast test-multihost bench bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -11,6 +11,12 @@ install:
 # full suite on a virtual 8-device CPU mesh (conftest forces the backend)
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# the edit-test loop tier: everything not marked slow, parallelized;
+# target < 3 min (the slow marks carry the multi-process / training
+# heavyweights — CI runs `test-fast` on PRs and `test` on merges)
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow" -p xdist -n 4
 
 # just the real 2-process distributed suite
 test-multihost:

@@ -253,7 +253,11 @@ def config5_distributed_sgd(
         "value": round(n_rows / dt, 1),
         "unit": "rows/s",
         "seconds_per_step": round(dt, 4),
-        "rel_param_error": round(err, 4),
+        # distance to the NOISY problem's generating weights — bounded
+        # below by the noise floor, NOT an optimizer error (correctness is
+        # the oracle delta, ~1e-6); named so the artifact can't be misread
+        # as a 31% optimizer error
+        "rel_param_error_vs_ground_truth_under_noise": round(err, 4),
         "oracle_rel_delta": round(oracle_delta, 8),
     }
 

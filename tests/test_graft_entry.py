@@ -7,6 +7,7 @@ chips).  These tests pin the self-provisioning contract.
 """
 
 import os
+import pytest
 import subprocess
 import sys
 
@@ -17,6 +18,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import __graft_entry__ as graft  # noqa: E402
+
+#: multi-process spawns / full-model training sweeps: the suite's
+#: heavyweights (measured r05 durations); `make test-fast` skips them
+pytestmark = pytest.mark.slow
 
 
 def test_entry_is_jittable():

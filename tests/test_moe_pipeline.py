@@ -16,6 +16,10 @@ from tensorframes_tpu.parallel.pipeline import (
     pipeline_reference,
 )
 
+#: full-model pipeline/MoE training sweeps: suite heavyweights (measured
+#: r05 durations); `make test-fast` skips them
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def nprng():

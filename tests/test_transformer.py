@@ -43,6 +43,7 @@ def test_flash_matches_reference(params, tokens):
     np.testing.assert_allclose(fl, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_matches_reference(params, tokens):
     mesh = make_mesh({"sp": 4})
     ref = np.asarray(transformer_logits(params, tokens, attn_impl="reference"))
@@ -82,6 +83,7 @@ def test_score_frame(params):
     assert all(np.isfinite(r.nll) and r.nll > 0 for r in rows)
 
 
+@pytest.mark.slow
 class TestFitShardedDpSp:
     """dp x sp composition in ONE train step: batch-sharded ring attention
     plus GSPMD gradient all-reduce."""
@@ -209,6 +211,7 @@ class TestRemat:
         assert all(np.isfinite(l2))
 
 
+@pytest.mark.slow
 class TestGenerate:
     """KV-cached scan decode vs the naive oracle: re-run the full forward
     on the growing sequence and argmax the last position."""
@@ -319,6 +322,7 @@ class TestGenerate:
         assert len(lm._generate_cache) == lm._GENERATE_CACHE_MAX
 
 
+@pytest.mark.slow
 class TestSamplingFilters:
     """filter_logits (top-k / nucleus) and their wiring into generate."""
 
@@ -380,6 +384,7 @@ class TestSamplingFilters:
         assert len(lm._generate_cache) == 1
 
 
+@pytest.mark.slow
 class TestRaggedPrompts:
     """Left-padded variable-length prompt batches: each row must decode
     exactly as it would alone."""
@@ -425,6 +430,7 @@ class TestRaggedPrompts:
         np.testing.assert_array_equal(ragged, plain)
 
 
+@pytest.mark.slow
 class TestMoETransformer:
     """Transformer blocks with a routed MoE MLP (moe_experts=...)."""
 
@@ -479,6 +485,7 @@ class TestMoETransformer:
         assert float(aux) > 0
 
 
+@pytest.mark.slow
 class TestGQA:
     """Grouped-query attention: n_kv_heads k/v heads shared by
     n_heads/n_kv_heads query heads each. Exact oracle: an MHA model whose
