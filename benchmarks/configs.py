@@ -347,6 +347,42 @@ def config7_dense_map_rows(n_rows: int = 1_000_000) -> Dict:
     dt_rows = _timeit(run_rows, iters=3)
     dt_blocks = _timeit(run_blocks, iters=3)
     np.testing.assert_allclose(run_rows(), x * 2.0 + 1.0, rtol=1e-6)
+
+    # CHIP-SIDE decomposition (chain-length differential, the kernel-row
+    # methodology): the two paths' compiled programs — jit(vmap(fn)) for
+    # rows, jit(fn) for blocks — chained so constant RTT/dispatch terms
+    # cancel. This pins whether any end-to-end gap is chip work or link
+    # round-trips: the row path's retry contract costs one extra sync
+    # RTT per pass (eager materialization window), which is environment
+    # latency, invisible chip-side.
+    import jax
+
+    from benchmarks.attention_bench import _diff_time
+
+    xd = df.column_data("x").device()
+
+    def rows_chain(n):
+        def f(a):
+            def body(_, acc):
+                return jax.vmap(lambda v: v * 2.0 + 1.0)(acc)
+
+            return jax.lax.fori_loop(0, n, body, a)
+
+        return jax.jit(f)
+
+    def blocks_chain(n):
+        def f(a):
+            def body(_, acc):
+                return acc * 2.0 + 1.0
+
+            return jax.lax.fori_loop(0, n, body, a)
+
+        return jax.jit(f)
+
+    est = 2 * x.nbytes / 819e9  # HBM-bound elementwise op
+    t_rows_chip, _ = _diff_time(rows_chain, (xd,), est)
+    t_blocks_chip, _ = _diff_time(blocks_chain, (xd,), est)
+
     return {
         "metric": "config7_dense_map_rows_rows_per_sec",
         "value": round(n_rows / dt_rows, 1),
@@ -354,6 +390,9 @@ def config7_dense_map_rows(n_rows: int = 1_000_000) -> Dict:
         "seconds_per_pass": round(dt_rows, 4),
         "map_blocks_seconds_per_pass": round(dt_blocks, 4),
         "vs_map_blocks": round(dt_rows / dt_blocks, 3),
+        "chip_side_row_program_us": round(t_rows_chip * 1e6, 1),
+        "chip_side_block_program_us": round(t_blocks_chip * 1e6, 1),
+        "vs_map_blocks_chip_side": round(t_rows_chip / t_blocks_chip, 3),
     }
 
 
@@ -396,6 +435,23 @@ def config8_string_key_aggregate(
     got = run()
     assert got.shape[0] == n_groups
     np.testing.assert_allclose(float(got.sum()), float(x.sum()), rtol=1e-3)
+
+    # decompose the fresh-frame cost: the host coding pass alone (the
+    # native list-direct coder, r05) vs the remainder — the codes upload
+    # (narrowed to the smallest dtype that fits the group ids, here
+    # uint16) + device argsort + boundary readback, which scale with
+    # LINK bandwidth, not host speed. Without the split, link weather
+    # reads as a coding regression (r04's 4.36 s was ~75% upload).
+    from tensorframes_tpu.data.packer import code_keys
+
+    t0 = time.perf_counter()
+    codes = code_keys(keys)
+    dt_code_host = time.perf_counter() - t0
+    code_bytes = None
+    if codes is not None:
+        mx = int(codes.max())
+        width = 1 if mx < 256 else (2 if mx < 65536 else 4)
+        code_bytes = n_rows * width
     # the sort permutation (and its coding pass) memoizes per frame, so
     # the timed passes above exclude coding; fresh data pays both, which
     # is what value reports
@@ -405,6 +461,15 @@ def config8_string_key_aggregate(
         "unit": "rows/s",
         "seconds_per_pass_memoized_sort": round(dt, 4),
         "key_coding_and_sort_seconds": round(dt_coding, 4),
+        "key_coding_host_seconds": round(dt_code_host, 4)
+        if codes is not None
+        else None,
+        "codes_upload_mb": round(code_bytes / 1e6, 1)
+        if code_bytes
+        else None,
+        "upload_sort_readback_seconds": round(dt_coding - dt_code_host, 4)
+        if codes is not None
+        else None,
         "n_groups": n_groups,
     }
 

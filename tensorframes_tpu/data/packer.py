@@ -27,6 +27,7 @@ __all__ = [
     "gather_rows",
     "scatter_rows",
     "gather_ragged_pad",
+    "code_keys",
     "set_native_threads",
     "native_threads",
 ]
@@ -47,6 +48,11 @@ _LIB = os.path.join(_NATIVE_DIR, "libtfspacker.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+
+_SRC_CODER = os.path.join(_NATIVE_DIR, "coder.cpp")
+_LIB_CODER = os.path.join(_NATIVE_DIR, "libtfscoder.so")
+_coder_lib = None
+_coder_tried = False
 
 
 def _build() -> bool:
@@ -94,9 +100,9 @@ def _load() -> Optional[ctypes.CDLL]:
             abi = lib.tfs_packer_abi_version()
         except AttributeError:
             abi = -1
-        if abi != 2:
+        if abi != 3:
             logger.warning(
-                "native packer ABI %s != 2; using numpy fallback", abi
+                "native packer ABI %s != 3; using numpy fallback", abi
             )
             return None
         lib.tfs_pad_ragged.argtypes = [
@@ -119,12 +125,85 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tfs_executor_set_threads.argtypes = [c_i64]
         lib.tfs_executor_set_threads.restype = c_i64
         lib.tfs_executor_threads.restype = c_i64
+        lib.tfs_code_keys.argtypes = [
+            c_char_p, p_i64, c_i64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.tfs_code_keys.restype = c_i64
         _lib = lib
         return _lib
 
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def _load_coder():
+    """The list-direct key coder (libtfscoder.so) is built and loaded
+    separately from the packer kernels: it links against the CPython API
+    (sysconfig include/lib paths), and a host where that fails must not
+    take down the plain packer .so. Loaded with ``PyDLL`` — the
+    extraction phase reads PyBytes internals and must hold the GIL (the
+    library releases it itself around the hash pass)."""
+    global _coder_lib, _coder_tried
+    if _coder_lib is not None or _coder_tried:
+        return _coder_lib
+    with _lock:
+        if _coder_lib is not None or _coder_tried:
+            return _coder_lib
+        _coder_tried = True
+        import sysconfig
+
+        try:
+            need_build = not os.path.exists(_LIB_CODER) or (
+                os.path.getmtime(_LIB_CODER) < os.path.getmtime(_SRC_CODER)
+            )
+        except OSError:
+            # source pruned from the install: a prebuilt library is
+            # usable as-is (the ABI gate below rejects stale ones)
+            need_build = not os.path.exists(_LIB_CODER)
+        if need_build:
+            cmd = [
+                "g++", "-O3", "-shared", "-fPIC", "-pthread",
+                f"-I{sysconfig.get_paths()['include']}",
+                _SRC_CODER, "-o", _LIB_CODER,
+            ]
+            libdir = sysconfig.get_config_var("LIBDIR")
+            if libdir:
+                cmd.insert(-2, f"-L{libdir}")
+            try:
+                res = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=120
+                )
+            except (OSError, subprocess.TimeoutExpired) as e:
+                logger.info("native coder build unavailable: %s", e)
+                return None
+            if res.returncode != 0:
+                logger.warning(
+                    "native coder build failed:\n%s", res.stderr
+                )
+                return None
+        try:
+            lib = ctypes.PyDLL(_LIB_CODER)
+        except OSError as e:
+            logger.warning("native coder load failed: %s", e)
+            return None
+        try:
+            lib.tfs_coder_abi_version.restype = ctypes.c_int64
+            abi = lib.tfs_coder_abi_version()
+        except AttributeError:
+            abi = -1
+        if abi != 1:
+            logger.warning(
+                "native coder ABI %s != 1; using fallback", abi
+            )
+            return None
+        lib.tfs_code_keys_list.argtypes = [
+            ctypes.py_object, ctypes.POINTER(ctypes.c_int32)
+        ]
+        lib.tfs_code_keys_list.restype = ctypes.c_int64
+        _coder_lib = lib
+        return _coder_lib
 
 
 def set_native_threads(n: int) -> int:
@@ -338,3 +417,53 @@ def gather_ragged_pad(
         row = flat[offsets[i] : offsets[i + 1]]
         out[k, : len(row)] = row
     return out
+
+
+def code_keys(cells) -> Optional[np.ndarray]:
+    """First-appearance integer codes for a list of byte strings — the
+    group-by key coding pass (the role ``pandas.factorize`` plays on the
+    fallback path). Two native paths, fastest first:
+
+    1. list-direct (libtfscoder.so): pointers read straight out of the
+       PyBytes objects under the GIL, hash pass with the GIL released —
+       no marshalling at all (building a contiguous buffer from Python
+       measured 4.5 s against 0.5 s of hashing at 10M rows);
+    2. buffer path (libtfspacker.so): join + offsets, for cell lists
+       holding non-``bytes`` byte-likes.
+
+    Both are chunk-parallel with a first-appearance merge (serial on
+    one-CPU hosts). Returns int32 codes (a group id is bounded by the
+    row count), or ``None`` when no native library is available or a
+    cell is not bytes-like (callers fall back to pandas/numpy)."""
+    n = len(cells)
+    if n == 0:
+        return np.empty(0, dtype=np.int32)
+    codes = np.empty(n, dtype=np.int32)
+    coder = _load_coder()
+    if coder is not None and isinstance(cells, list):
+        got = coder.tfs_code_keys_list(
+            cells, codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        )
+        if got >= 0:
+            return codes
+        if got != -2:  # -2 = non-bytes cell; try the buffer path
+            return None
+    lib = _load()
+    if lib is None:
+        return None
+    try:
+        buf = b"".join(cells)
+    except TypeError:
+        return None
+    lengths = np.fromiter(
+        (len(c) for c in cells), dtype=np.int64, count=n
+    )
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    got = lib.tfs_code_keys(
+        buf, _i64ptr(offsets), n,
+        codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if got < 0:
+        return None
+    return codes

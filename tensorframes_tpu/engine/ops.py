@@ -1086,7 +1086,14 @@ def _map_rows_thunk(
                     feed = {ph: feeders[ph](lo, hi) for ph in binding}
                     try:
                         res = run_bucket(feed, hi - lo)
-                        if probe_size == fast_chunk:
+                        # the raised-chunk OOM probe syncs so halving can
+                        # react before the rest of the pass dispatches —
+                        # pointless when this chunk IS the whole pass (the
+                        # terminal sync right below catches it, and the
+                        # caller's row-cap retry recovers); skipping it
+                        # saves one ~100-200ms tunnel round trip per
+                        # single-chunk pass (the r04 config7 gap)
+                        if probe_size == fast_chunk and hi < n:
                             jax.block_until_ready(res)
                             probe_size = None
                     except Exception as e:
@@ -1628,6 +1635,15 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
             return rank[inv.reshape(-1)]
 
         def binary_codes(cells) -> np.ndarray:
+            # fastest path: the native thread-pool coder (parallel local
+            # dictionaries + first-appearance merge, executor.cpp); it
+            # returns None without the compiled library or on non-bytes
+            # cells, falling through to pandas/numpy
+            from ..data.packer import code_keys
+
+            native = code_keys(cells)
+            if native is not None:
+                return native.astype(np.int64, copy=False)
             if pd is not None:
                 arr = np.empty(n, dtype=object)
                 # storage cells are bytes already: direct elementwise
@@ -1663,7 +1679,14 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
                 return out
             arr = np.asarray([bytes(c) + b"\x01" for c in cells])
             _, inv = np.unique(arr, return_inverse=True)
+            inexact_order.append(True)  # unique sorts; not first-appearance
             return inv.reshape(-1).astype(np.int64)
+
+        #: coders append here when their output is NOT first-appearance
+        #: ordered (numpy unique fallbacks sort; the NaN branch appends
+        #: singletons at the end of the range); a single-column result
+        #: then gets one renumber pass, exact coders skip it
+        inexact_order = []
 
         def numeric_codes(vals: np.ndarray) -> np.ndarray:
             # NaN semantics must match the dense-numeric path and the old
@@ -1672,6 +1695,7 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
             if np.issubdtype(vals.dtype, np.floating):
                 nan = np.isnan(vals)
                 if nan.any():
+                    inexact_order.append(True)
                     out = np.empty(n, dtype=np.int64)
                     nn = vals[~nan]
                     if pd is not None:
@@ -1685,6 +1709,7 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
             if pd is not None:
                 return pd.factorize(vals)[0].astype(np.int64, copy=False)
             _, inv = np.unique(vals, return_inverse=True)
+            inexact_order.append(True)  # unique sorts; not first-appearance
             return inv.reshape(-1).astype(np.int64)
 
         per_col = [
@@ -1695,21 +1720,36 @@ def _group_sort_impl(dframe: TensorFrame, keys: Sequence[str], binding) -> Tuple
             codes = per_col[0]
             for nxt in per_col[1:]:
                 # re-factorize after each pairwise combine so the running
-                # code range stays < n and the product cannot overflow
+                # code range stays < n and the product cannot overflow;
+                # factorize output is first-appearance, so combined codes
+                # need no extra renumber
                 codes = pd.factorize(
                     codes * (np.int64(nxt.max(initial=0)) + 1) + nxt
                 )[0]
-            # final renumber: per-column codes are first-appearance except
-            # for the NaN rows appended at the end of the range
-            codes = pd.factorize(codes)[0].astype(np.int64, copy=False)
+            if len(per_col) == 1 and inexact_order:
+                # the one non-first-appearance coder: NaN singleton rows
+                # appended at the end of the range
+                codes = pd.factorize(codes)[0]
+            codes = codes.astype(np.int64, copy=False)
         elif len(per_col) == 1:
-            codes = first_appearance_codes(per_col[0])
+            codes = (
+                first_appearance_codes(per_col[0])
+                if inexact_order
+                else per_col[0]
+            )
         else:
             codes = first_appearance_codes(
                 np.stack(per_col, axis=1), axis=0
             )
-        if n < 2**31:
-            # codes are row indices at most: int32 halves the upload
+        # codes are group ids < n: the narrowest dtype cuts the one
+        # unavoidable link transfer of the string-key path (the codes
+        # upload; order/flags already stay device-side) by 2-4x
+        mx = int(codes.max()) if codes.size else 0
+        if mx < (1 << 8):
+            codes = codes.astype(np.uint8)
+        elif mx < (1 << 16):
+            codes = codes.astype(np.uint16)
+        elif n < 2**31:
             codes = codes.astype(np.int32, copy=False)
         codes_dev = jnp.asarray(codes)
         order_dev = jnp.argsort(codes_dev, stable=True)

@@ -1,0 +1,240 @@
+// Native group-key coder, list-direct path.
+//
+// The aggregate path's string-key coding (see engine/ops.py
+// _group_sort_impl) needs first-appearance integer codes for N byte
+// strings held in a Python list. Marshalling them into a contiguous
+// buffer from Python costs more than the coding itself (measured 4.5 s
+// of join + len() loops against 0.5 s of hashing at 10M rows), so this
+// library takes the list itself: pointers are read via the CPython API
+// under the GIL (zero copies — PyBytes internals are stable while the
+// list holds references), then the GIL is RELEASED for the hash pass.
+//
+// The hash pass is chunk-parallel (one local open-addressing table per
+// chunk, a serial first-appearance merge over distinct entries, then a
+// parallel translate — the same scheme as tfs_code_keys in
+// executor.cpp) and degenerates to a single serial pass on one-CPU
+// hosts. Open addressing with byte-wise FNV-1a beats unordered_map by
+// avoiding per-node allocation; slots store the first row index of the
+// key so comparisons read the original bytes.
+//
+// Built as its own shared object (libtfscoder.so): it links against the
+// CPython API, and a host where that fails must not take down the plain
+// packer kernels in libtfspacker.so.
+
+#include <Python.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct View {
+  const char* p;
+  int64_t len;
+};
+
+inline uint64_t Hash(const View& v) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a
+  for (int64_t i = 0; i < v.len; ++i) {
+    h ^= static_cast<unsigned char>(v.p[i]);
+    h *= 1099511628211ull;
+  }
+  return h ^ (h >> 32);
+}
+
+inline bool Eq(const View& a, const View& b) {
+  return a.len == b.len && std::memcmp(a.p, b.p, a.len) == 0;
+}
+
+// open-addressing table of row indices; the key of slot s is
+// views[slots[s]]. -1 = empty.
+class Table {
+ public:
+  explicit Table(int64_t expected) {
+    int64_t cap = 64;
+    while (cap < expected * 2) cap <<= 1;
+    mask_ = cap - 1;
+    slots_.assign(cap, -1);
+  }
+
+  // returns the representative row of the key (inserting row if new)
+  int64_t FindOrInsert(const std::vector<View>& views, int64_t row) {
+    const View& key = views[row];
+    uint64_t s = Hash(key) & mask_;
+    for (;;) {
+      int64_t r = slots_[s];
+      if (r < 0) {
+        if (static_cast<int64_t>(count_) * 2 >
+            static_cast<int64_t>(slots_.size())) {
+          Grow(views);
+          return FindOrInsert(views, row);
+        }
+        slots_[s] = row;
+        ++count_;
+        return row;
+      }
+      if (Eq(views[r], key)) return r;
+      s = (s + 1) & mask_;
+    }
+  }
+
+  int64_t size() const { return count_; }
+
+ private:
+  void Grow(const std::vector<View>& views) {
+    std::vector<int64_t> old;
+    old.swap(slots_);
+    mask_ = mask_ * 2 + 1;
+    slots_.assign(mask_ + 1, -1);
+    for (int64_t r : old) {
+      if (r < 0) continue;
+      uint64_t s = Hash(views[r]) & mask_;
+      while (slots_[s] >= 0) s = (s + 1) & mask_;
+      slots_[s] = r;
+    }
+  }
+
+  std::vector<int64_t> slots_;
+  uint64_t mask_;
+  int64_t count_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+int64_t tfs_coder_abi_version() { return 1; }
+
+// First-appearance int32 codes for a list of bytes objects. Returns the
+// distinct-key count, -2 when an element is not exactly `bytes` (caller
+// falls back to the buffer path), -1 on other errors.
+int64_t tfs_code_keys_list(PyObject* list, int32_t* out_codes) {
+  if (!PyList_Check(list)) return -1;
+  const int64_t n = PyList_GET_SIZE(list);
+  if (n == 0) return 0;
+  std::vector<View> views(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(list, i);  // borrowed
+    if (!PyBytes_Check(o)) return -2;
+    views[static_cast<size_t>(i)] = {PyBytes_AS_STRING(o),
+                                     PyBytes_GET_SIZE(o)};
+  }
+
+  int64_t groups = 0;
+  Py_BEGIN_ALLOW_THREADS;
+
+  int64_t threads = static_cast<int64_t>(std::thread::hardware_concurrency());
+  if (threads < 1) threads = 1;
+  if (threads > 16) threads = 16;
+  int64_t chunks = std::min<int64_t>(threads, (n + 65535) / 65536);
+  if (chunks < 1) chunks = 1;
+  const int64_t per = (n + chunks - 1) / chunks;
+
+  // phase 1: per-chunk local coding (provisional code = local rank)
+  std::vector<std::vector<int64_t>> first_rows(
+      static_cast<size_t>(chunks));
+  auto local_pass = [&](int64_t c) {
+    const int64_t b = c * per;
+    const int64_t e = std::min(n, b + per);
+    Table t(std::min<int64_t>(e - b, 1 << 16));
+    std::vector<int64_t>& fr = first_rows[static_cast<size_t>(c)];
+    for (int64_t i = b; i < e; ++i) {
+      const int64_t rep = t.FindOrInsert(views, i);
+      if (rep == i) {
+        out_codes[i] = static_cast<int32_t>(fr.size());
+        fr.push_back(i);
+      } else {
+        out_codes[i] = out_codes[rep];
+      }
+    }
+  };
+  if (chunks == 1) {
+    local_pass(0);
+  } else {
+    std::vector<std::thread> ts;
+    for (int64_t c = 1; c < chunks; ++c) {
+      ts.emplace_back(local_pass, c);
+    }
+    local_pass(0);
+    for (auto& t : ts) t.join();
+  }
+
+  // phase 2: serial merge over distinct entries, first-appearance order
+  struct Entry {
+    int64_t row;
+    int32_t chunk;
+    int32_t local;
+  };
+  std::vector<Entry> entries;
+  size_t total = 0;
+  for (const auto& fr : first_rows) total += fr.size();
+  entries.reserve(total);
+  for (int64_t c = 0; c < chunks; ++c) {
+    const auto& fr = first_rows[static_cast<size_t>(c)];
+    for (size_t l = 0; l < fr.size(); ++l) {
+      entries.push_back({fr[l], static_cast<int32_t>(c),
+                         static_cast<int32_t>(l)});
+    }
+  }
+  if (chunks == 1) {
+    groups = static_cast<int64_t>(entries.size());
+  } else {
+    std::sort(entries.begin(), entries.end(),
+              [](const Entry& a, const Entry& b) { return a.row < b.row; });
+    Table g(static_cast<int64_t>(entries.size()));
+    std::vector<std::vector<int32_t>> trans(static_cast<size_t>(chunks));
+    for (int64_t c = 0; c < chunks; ++c) {
+      trans[static_cast<size_t>(c)].resize(
+          first_rows[static_cast<size_t>(c)].size());
+    }
+    // the Table returns the FIRST row inserted for each key, which
+    // under row-sorted insertion IS the global first appearance;
+    // rep_gid (sorted by rep row, append-only) maps it to its code
+    int64_t next = 0;
+    std::vector<std::pair<int64_t, int32_t>> rep_gid;
+    rep_gid.reserve(entries.size());
+    for (const Entry& en : entries) {
+      const int64_t rep = g.FindOrInsert(views, en.row);
+      int32_t gid;
+      if (rep == en.row) {
+        gid = static_cast<int32_t>(next++);
+        rep_gid.push_back({rep, gid});
+      } else {
+        // find the gid assigned to rep: rep rows arrive sorted, so a
+        // binary search over rep_gid (sorted by rep row) resolves it
+        auto it = std::lower_bound(
+            rep_gid.begin(), rep_gid.end(), std::make_pair(rep, 0),
+            [](const std::pair<int64_t, int32_t>& a,
+               const std::pair<int64_t, int32_t>& b) {
+              return a.first < b.first;
+            });
+        gid = it->second;
+      }
+      trans[static_cast<size_t>(en.chunk)][static_cast<size_t>(en.local)] =
+          gid;
+    }
+    groups = next;
+
+    // phase 3: parallel translate
+    auto translate = [&](int64_t c) {
+      const auto& tr = trans[static_cast<size_t>(c)];
+      const int64_t b = c * per;
+      const int64_t e = std::min(n, b + per);
+      for (int64_t i = b; i < e; ++i) {
+        out_codes[i] = tr[static_cast<size_t>(out_codes[i])];
+      }
+    };
+    std::vector<std::thread> ts;
+    for (int64_t c = 1; c < chunks; ++c) ts.emplace_back(translate, c);
+    translate(0);
+    for (auto& t : ts) t.join();
+  }
+
+  Py_END_ALLOW_THREADS;
+  return groups;
+}
+
+}  // extern "C"

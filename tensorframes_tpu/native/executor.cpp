@@ -13,12 +13,15 @@
 // Build: compiled together with packer.cpp into libtfspacker.so (see
 // tensorframes_tpu/data/packer.py).
 
+#include <algorithm>
 #include <condition_variable>
 #include <cstdint>
 #include <functional>
 #include <mutex>
 #include <queue>
+#include <string_view>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include "kernels.h"
@@ -213,6 +216,113 @@ void tfs_par_gather_ragged_pad(const char* flat, const int64_t* offsets,
         tfs::GatherRaggedPadRange(
             flat, offsets, idx, b, e, max_len, elem_size, pad_elem, out);
       });
+}
+
+// First-appearance integer coding of n byte strings (the group-by key
+// coding pass, the analog of pandas.factorize for the aggregate path):
+// strings live in one packed buffer with offsets[n+1]. Two parallel
+// phases around a tiny serial merge:
+//   1. each chunk builds a local string -> local-code map and writes
+//      provisional local codes;
+//   2. local dictionaries merge by GLOBAL first-appearance row (sorted
+//      over sum-of-distinct entries, usually << n), yielding a
+//      local-code -> global-code translation per chunk;
+//   3. chunks translate their provisional codes in place.
+// Returns the number of distinct keys, or -1 on error. Codes land in
+// int32 (a group id is bounded by the row count; callers narrow further
+// for the device upload).
+int64_t tfs_code_keys(const char* buf, const int64_t* offsets, int64_t n,
+                      int32_t* out_codes) {
+  if (n <= 0) return 0;
+  PoolLease pool;
+  const int64_t workers = pool->size() + 1;
+  // chunk layout must be reproducible across the two phases: fix it here
+  int64_t chunks = std::min<int64_t>(workers, (n + 65535) / 65536);
+  if (chunks < 1) chunks = 1;
+  const int64_t per = (n + chunks - 1) / chunks;
+
+  struct LocalDict {
+    std::unordered_map<std::string_view, int32_t> map;
+    std::vector<int64_t> first_row;  // local code -> global first row
+  };
+  std::vector<LocalDict> dicts(static_cast<size_t>(chunks));
+
+  pool->ParallelFor(chunks, 1, [&](int64_t cb, int64_t ce) {
+    for (int64_t c = cb; c < ce; ++c) {
+      LocalDict& d = dicts[static_cast<size_t>(c)];
+      const int64_t b = c * per;
+      const int64_t e = std::min(n, b + per);
+      d.map.reserve(256);
+      for (int64_t i = b; i < e; ++i) {
+        const std::string_view key(buf + offsets[i],
+                                   static_cast<size_t>(offsets[i + 1] -
+                                                       offsets[i]));
+        auto it = d.map.find(key);
+        if (it == d.map.end()) {
+          const int32_t code = static_cast<int32_t>(d.first_row.size());
+          d.map.emplace(key, code);
+          d.first_row.push_back(i);
+          out_codes[i] = code;
+        } else {
+          out_codes[i] = it->second;
+        }
+      }
+    }
+  });
+
+  // serial merge over the distinct entries only
+  struct Entry {
+    int64_t row;
+    int32_t chunk;
+    int32_t local;
+  };
+  std::vector<Entry> entries;
+  size_t total = 0;
+  for (const auto& d : dicts) total += d.first_row.size();
+  entries.reserve(total);
+  for (int64_t c = 0; c < chunks; ++c) {
+    const auto& fr = dicts[static_cast<size_t>(c)].first_row;
+    for (size_t l = 0; l < fr.size(); ++l) {
+      entries.push_back({fr[l], static_cast<int32_t>(c),
+                         static_cast<int32_t>(l)});
+    }
+  }
+  std::sort(entries.begin(), entries.end(),
+            [](const Entry& a, const Entry& b) { return a.row < b.row; });
+  std::unordered_map<std::string_view, int32_t> global;
+  global.reserve(entries.size());
+  std::vector<std::vector<int32_t>> trans(static_cast<size_t>(chunks));
+  for (int64_t c = 0; c < chunks; ++c) {
+    trans[static_cast<size_t>(c)].resize(
+        dicts[static_cast<size_t>(c)].first_row.size());
+  }
+  for (const Entry& en : entries) {
+    const std::string_view key(buf + offsets[en.row],
+                               static_cast<size_t>(offsets[en.row + 1] -
+                                                   offsets[en.row]));
+    auto it = global.find(key);
+    int32_t gid;
+    if (it == global.end()) {
+      gid = static_cast<int32_t>(global.size());
+      global.emplace(key, gid);
+    } else {
+      gid = it->second;
+    }
+    trans[static_cast<size_t>(en.chunk)][static_cast<size_t>(en.local)] =
+        gid;
+  }
+
+  pool->ParallelFor(chunks, 1, [&](int64_t cb, int64_t ce) {
+    for (int64_t c = cb; c < ce; ++c) {
+      const auto& tr = trans[static_cast<size_t>(c)];
+      const int64_t b = c * per;
+      const int64_t e = std::min(n, b + per);
+      for (int64_t i = b; i < e; ++i) {
+        out_codes[i] = tr[static_cast<size_t>(out_codes[i])];
+      }
+    }
+  });
+  return static_cast<int64_t>(global.size());
 }
 
 }  // extern "C"

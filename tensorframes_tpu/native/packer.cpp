@@ -95,6 +95,6 @@ void tfs_scatter_rows(const char* src,
   tfs::ScatterRowsRange(src, row_bytes, idx, 0, n_idx, out);
 }
 
-int64_t tfs_packer_abi_version() { return 2; }
+int64_t tfs_packer_abi_version() { return 3; }
 
 }  // extern "C"

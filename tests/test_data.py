@@ -263,3 +263,61 @@ class TestNativeExecutor:
         assert P.native_threads() == 2
         P.set_native_threads(0)
         assert P.native_threads() >= 1
+
+
+class TestCodeKeys:
+    """The native group-key coder (both paths: list-direct via the
+    CPython API, and the buffer path on the packer pool) must agree with
+    pandas.factorize's first-appearance contract exactly — the aggregate
+    path's group ordering depends on it."""
+
+    def test_first_appearance_parity_with_pandas(self):
+        pd = pytest.importorskip("pandas")
+        from tensorframes_tpu.data.packer import code_keys
+
+        rng = np.random.default_rng(1)
+        for n, g in [(1000, 7), (20_000, 997), (5_000, 5_000)]:
+            keys = [b"key_%d" % rng.integers(0, g) for _ in range(n)]
+            got = code_keys(keys)
+            if got is None:  # no toolchain: fallback paths cover it
+                pytest.skip("native coder unavailable")
+            arr = np.empty(n, dtype=object)
+            arr[:] = keys
+            np.testing.assert_array_equal(got, pd.factorize(arr)[0])
+
+    def test_edge_cases(self):
+        from tensorframes_tpu.data.packer import code_keys
+
+        if code_keys([b"x"]) is None:
+            pytest.skip("native coder unavailable")
+        assert code_keys([]).shape == (0,)
+        assert code_keys([b""]).tolist() == [0]
+        assert code_keys([b"", b"a", b""]).tolist() == [0, 1, 0]
+        # byte-likes that are not bytes take the buffer path
+        got = code_keys([memoryview(b"xy"), b"xy", bytearray(b"z")])
+        if got is not None:
+            assert got.tolist() == [0, 0, 1]
+        # non-bytes-like falls back to None (callers use pandas)
+        assert code_keys([b"a", 3]) is None
+
+    def test_aggregate_string_keys_with_narrow_codes(self):
+        """End to end through aggregate: group count under 256 exercises
+        the uint8 upload narrowing; results must match a host oracle."""
+        import tensorframes_tpu as tft
+
+        rng = np.random.default_rng(2)
+        n, g = 5000, 100
+        gid = rng.integers(0, g, size=n)
+        keys = [b"grp_%03d" % i for i in gid]
+        vals = rng.normal(size=n).astype(np.float32)
+        df = tft.TensorFrame.from_columns({"k": keys, "x": vals}).analyze()
+        out = tft.aggregate(
+            lambda x_input: {"x": x_input.sum(axis=0)}, df.group_by("k")
+        )
+        got = {r.k: float(r.x) for r in out.collect()}
+        oracle = {}
+        for kk, v in zip(keys, vals):
+            oracle[kk] = oracle.get(kk, 0.0) + float(v)
+        assert set(got) == set(oracle)
+        for kk in oracle:
+            np.testing.assert_allclose(got[kk], oracle[kk], rtol=1e-4)
