@@ -1,0 +1,219 @@
+"""Round-5 sweep, part 2: per-kernel backward tuning + D=64 fwd extras.
+
+Part 1 (flash_sweep_r05.py) timed the backward PAIR with one shared tile
+pair; here the dq kernel and the dk/dv kernel are timed separately so
+each can pick its own tiles (they run different matmul mixes on
+different grid orders), then the best combination is confirmed as a
+pair. D=64 forward adds the smaller-tile candidates part 1 skipped.
+
+Prints one JSON line per point; writes flash_sweep2_r05.json.
+"""
+
+import functools
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.attention_bench import _diff_time, _make_qkv
+from benchmarks.flash_sweep_r05 import bwd_point, fwd_point
+
+_PEAK = 197e12
+
+
+def _bwd_setup(L, D, B, H, causal):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.ops.attention import _flash_forward
+
+    q, k, v = _make_qkv(L, B, H, D, "bfloat16")
+    bh = B * H
+    qf, kf, vf = (a.reshape(bh, L, D) for a in (q, k, v))
+    o, lse = _flash_forward(q, k, v, causal, 1024, 1024, False)
+    dof = jnp.ones((bh, L, D), jnp.bfloat16)
+    delta = (
+        dof.astype(jnp.float32) * o.reshape(bh, L, D).astype(jnp.float32)
+    ).sum(axis=-1, keepdims=True)
+    return qf, kf, vf, dof, jax.lax.stop_gradient(lse), delta
+
+
+def dq_kernel_point(L, D, bq, bk, B=1, H=4, causal=True):
+    """Time ONLY the dq pallas call (3 matmuls/tile, k innermost)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tensorframes_tpu.ops.attention import (
+        _dim_semantics,
+        _flash_bwd_dq_kernel,
+    )
+
+    qf, kf, vf, dof, lse, delta = _bwd_setup(L, D, B, H, causal)
+    bh = B * H
+    scale = 1.0 / float(np.sqrt(D))
+
+    q_spec = pl.BlockSpec(
+        (1, bq, D), lambda bi, qi, ki: (bi, qi, 0), memory_space=pltpu.VMEM
+    )
+    k_spec = pl.BlockSpec(
+        (1, bk, D), lambda bi, qi, ki: (bi, ki, 0), memory_space=pltpu.VMEM
+    )
+    row_spec = pl.BlockSpec(
+        (1, bq, 1), lambda bi, qi, ki: (bi, qi, 0), memory_space=pltpu.VMEM
+    )
+
+    def one(qq):
+        return pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dq_kernel, block_q=bq, block_k=bk,
+                causal=causal, offset=0, scale=scale,
+            ),
+            grid=(bh, L // bq, L // bk),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            out_shape=jax.ShapeDtypeStruct((bh, L, D), jnp.bfloat16),
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+            compiler_params=_dim_semantics(pltpu, False),
+            interpret=False,
+        )(qq, kf, vf, dof, lse, delta)
+
+    def chain(n):
+        def f(qq):
+            def body(_, acc):
+                return one(acc).astype(acc.dtype)
+
+            return jax.lax.fori_loop(0, n, body, qq)
+
+        return jax.jit(f)
+
+    # dq kernel: 3 of the 7 real matmul passes -> 1.5x fwd volume
+    flops = 1.5 * 4.0 * B * H * L * L * D * (0.5 if causal else 1.0)
+    try:
+        per, chains = _diff_time(chain, (qf,), flops / (0.4 * _PEAK))
+    except Exception as e:
+        return {"metric": "flash_bwd_dq_kernel", "seq_len": L,
+                "head_dim": D, "block_q": bq, "block_k": bk,
+                "error": str(e)[:160]}
+    tf = flops / per / 1e12
+    return {
+        "metric": "flash_bwd_dq_kernel", "seq_len": L, "head_dim": D,
+        "batch": B, "heads": H, "block_q": bq, "block_k": bk,
+        "ms": round(per * 1e3, 3), "tflops_model1p5x": round(tf, 2),
+        "mfu_pct_of_v5e_peak": round(100.0 * tf * 1e12 / _PEAK, 1),
+        "chain_lengths": chains,
+    }
+
+
+def dkv_kernel_point(L, D, bq, bk, B=1, H=4, causal=True):
+    """Time ONLY the dk/dv pallas call (4 matmuls/tile, q innermost)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tensorframes_tpu.ops.attention import (
+        _dim_semantics,
+        _flash_bwd_dkv_kernel,
+    )
+
+    qf, kf, vf, dof, lse, delta = _bwd_setup(L, D, B, H, causal)
+    bh = B * H
+    scale = 1.0 / float(np.sqrt(D))
+
+    qk_q_spec = pl.BlockSpec(
+        (1, bq, D), lambda bi, ki, qi: (bi, qi, 0), memory_space=pltpu.VMEM
+    )
+    qk_k_spec = pl.BlockSpec(
+        (1, bk, D), lambda bi, ki, qi: (bi, ki, 0), memory_space=pltpu.VMEM
+    )
+    qk_row_spec = pl.BlockSpec(
+        (1, bq, 1), lambda bi, ki, qi: (bi, qi, 0), memory_space=pltpu.VMEM
+    )
+
+    def one(kk):
+        return pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dkv_kernel, block_q=bq, block_k=bk,
+                causal=causal, offset=0, scale=scale,
+            ),
+            grid=(bh, L // bk, L // bq),
+            in_specs=[qk_q_spec, qk_k_spec, qk_k_spec, qk_q_spec,
+                      qk_row_spec, qk_row_spec],
+            out_specs=[qk_k_spec, qk_k_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, L, D), jnp.bfloat16),
+                jax.ShapeDtypeStruct((bh, L, D), jnp.bfloat16),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, D), jnp.float32),
+                pltpu.VMEM((bk, D), jnp.float32),
+            ],
+            compiler_params=_dim_semantics(pltpu, False),
+            interpret=False,
+        )(qf, kk, vf, dof, lse, delta)
+
+    def chain(n):
+        def f(kk):
+            def body(_, acc):
+                dk, dv = one(acc)
+                return (dk + dv).astype(acc.dtype)
+
+            return jax.lax.fori_loop(0, n, body, kk)
+
+        return jax.jit(f)
+
+    # dkv kernel: 4 of the 7 real matmul passes -> 2x fwd volume
+    flops = 2.0 * 4.0 * B * H * L * L * D * (0.5 if causal else 1.0)
+    try:
+        per, chains = _diff_time(chain, (kf,), flops / (0.4 * _PEAK))
+    except Exception as e:
+        return {"metric": "flash_bwd_dkv_kernel", "seq_len": L,
+                "head_dim": D, "block_q": bq, "block_k": bk,
+                "error": str(e)[:160]}
+    tf = flops / per / 1e12
+    return {
+        "metric": "flash_bwd_dkv_kernel", "seq_len": L, "head_dim": D,
+        "batch": B, "heads": H, "block_q": bq, "block_k": bk,
+        "ms": round(per * 1e3, 3), "tflops_model2x": round(tf, 2),
+        "mfu_pct_of_v5e_peak": round(100.0 * tf * 1e12 / _PEAK, 1),
+        "chain_lengths": chains,
+    }
+
+
+def main():
+    rows = []
+
+    def emit(r):
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+
+    L = 16384
+    # fwd D=64 smaller-tile candidates
+    for bq, bk in [(512, 1024), (1024, 512), (512, 512), (256, 1024)]:
+        emit(fwd_point(L, 64, bq, bk))
+
+    # dq kernel, D=128
+    for bq, bk in [(1024, 1024), (512, 2048), (512, 4096), (1024, 2048),
+                   (256, 2048)]:
+        emit(dq_kernel_point(L, 128, bq, bk))
+
+    # dkv kernel, D=128
+    for bq, bk in [(1024, 1024), (2048, 512), (4096, 512), (2048, 1024),
+                   (2048, 256), (1024, 512)]:
+        emit(dkv_kernel_point(L, 128, bq, bk))
+
+    with open(
+        os.path.join(os.path.dirname(__file__), "..",
+                     "flash_sweep2_r05.json"),
+        "w",
+    ) as f:
+        json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Round-5 sweep, part 3: measure the rewritten kernels on chip.
+
+After part 2's findings — the dkv kernel's axis-0 contractions cost
+relayouts (73% of ceiling vs the dq kernel's 93%), and a third of the
+D=64 forward's time is per-tile fixed cost — the dkv kernel was
+rewritten in the transposed-score formulation and a dual-head D=64
+forward landed. This sweep validates both on hardware and refreshes the
+train-step rows.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.attention_bench import bench_backward, bench_one
+from benchmarks.flash_sweep2_r05 import dkv_kernel_point
+from benchmarks.flash_sweep_r05 import bwd_point, fwd_point
+
+
+def main():
+    rows = []
+
+    def emit(r):
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+
+    L = 16384
+    # dual-head D=64 forward (same tile candidates as the incumbent)
+    emit(fwd_point(L, 64, 1024, 1024))
+    emit(fwd_point(L, 64, 1024, 2048))
+    emit(fwd_point(32768, 64, 1024, 1024, B=1, H=8))
+
+    # transposed-score dkv kernel, D=128
+    for bq, bk in [(1024, 1024), (512, 2048), (512, 1024), (1024, 2048)]:
+        emit(dkv_kernel_point(L, 128, bq, bk))
+
+    # backward pair + full train-step rows with the new kernels
+    emit(bwd_point(L, 128, 1024, 1024, B=1, H=4))
+    emit(bench_backward(L, B=1, H=4, D=128))
+    emit(bench_backward(32768, B=1, H=4, D=128))
+    emit(bench_backward(L, B=2, H=8, D=64))
+
+    with open(
+        os.path.join(os.path.dirname(__file__), "..",
+                     "flash_sweep3_r05.json"),
+        "w",
+    ) as f:
+        json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Round-5 sweep, part 4: VMEM-safe dual-head D=64 tiles + final rows.
+
+Part 3 found the dual-head forward exceeds the 16 MB scoped-VMEM budget
+at 1024x1024 (two f32 score tiles live at once); it is now gated to
+bq*bk <= 512k. This sweep measures the dual-head variant at its safe
+tiles against the single-head incumbent, and records the final
+train-step rows with the per-kernel backward tiles
+(dq 1024x1024 + dkv 512x2048).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.attention_bench import bench_backward, bench_one
+from benchmarks.flash_sweep_r05 import bwd_point, fwd_point
+
+
+def main():
+    rows = []
+
+    def emit(r):
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+
+    L = 16384
+    # dual-head D=64 at VMEM-safe tiles (bh=16, even -> dual engages)
+    for bq, bk in [(512, 1024), (1024, 512), (512, 512), (256, 2048)]:
+        emit(fwd_point(L, 64, bq, bk))
+    # the single-head incumbent for reference (odd-head shapes use it)
+    emit(fwd_point(L, 64, 1024, 1024, B=3, H=5))  # bh=15: single-head
+
+    # final D=128 train-step with the mixed backward tiles
+    emit(bench_backward(L, B=1, H=4, D=128))
+    emit(bench_backward(32768, B=1, H=4, D=128))
+
+    with open(
+        os.path.join(os.path.dirname(__file__), "..",
+                     "flash_sweep4_r05.json"),
+        "w",
+    ) as f:
+        json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

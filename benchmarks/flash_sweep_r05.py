@@ -1,0 +1,242 @@
+"""Round-5 flash tile sweep: D=64 forward + the backward pair, plus the
+hardware ceiling for D=64 attention matmuls.
+
+Three questions, all chain-differential timed (see attention_bench.py):
+
+1. What does the MXU actually deliver for the D=64 attention matmul
+   shapes? A [bq,64]x[64,bk] contraction uses 64 of the 128 systolic
+   rows and a [bq,bk]x[bk,64] product fills 64 of 128 output lanes —
+   both cap at half the 197 TF/s pass rate REGARDLESS of kernel quality.
+   The ceiling probe chains exactly those two matmuls (no softmax) and
+   measures the cap on this chip; kernel rows then report % of that
+   measured ceiling next to absolute MFU.
+2. Which (block_q, block_k) wins the D=64 forward? Tiles are half the
+   bytes of D=128, so 2048-wide tiles that blew VMEM at D=128 may fit.
+3. Which tiles win the backward pair (dq + dkv kernels)? r04 only swept
+   the forward; the backward runs a different matmul mix (5 products,
+   2 grids) and need not share the forward's optimum. flash_bwd_pair is
+   timed directly with fixed lse/delta so tile choice is isolated from
+   the VJP plumbing.
+
+Usage: python benchmarks/flash_sweep_r05.py [quick]
+Prints one JSON line per point; run on the real chip.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.attention_bench import _diff_time, _make_qkv
+from benchmarks.configs import _sync
+
+_PEAK = 197e12
+
+
+def matmul_ceiling(D, L=8192, bk=1024):
+    """Measured TF/s for the attention matmul pair at head_dim D:
+    s = q @ k^T ([L_tile,D]x[D,bk]) then o = s @ k ([L_tile,bk]x[bk,D]),
+    chained so the carry feeds the next iteration. This is the kernel's
+    roofline at this D on this chip — no softmax, no masking, no
+    pipeline; pure MXU."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1024, D)).astype(np.float32)).astype(
+        jnp.bfloat16
+    )
+    k = jnp.asarray(rng.normal(size=(bk, D)).astype(np.float32)).astype(
+        jnp.bfloat16
+    )
+
+    def chain(n):
+        def f(a, b):
+            def body(_, acc):
+                s = jax.lax.dot_general(
+                    acc, b, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                o = jax.lax.dot_general(
+                    s.astype(jnp.bfloat16), b, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                return o.astype(a.dtype)
+
+            return jax.lax.fori_loop(0, n, body, a)
+
+        return jax.jit(f)
+
+    flops = 2.0 * 1024 * bk * D * 2  # two products per iteration
+    per, chains = _diff_time(chain, (q, k), flops / (0.5 * _PEAK))
+    tf = flops / per / 1e12
+    return {
+        "metric": "attention_matmul_ceiling",
+        "head_dim": D,
+        "bk": bk,
+        "tflops": round(tf, 2),
+        "pct_of_v5e_peak": round(100.0 * tf * 1e12 / _PEAK, 1),
+        "chain_lengths": chains,
+    }
+
+
+def fwd_point(L, D, bq, bk, B=2, H=8, causal=True):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.ops.attention import flash_attention
+
+    q, k, v = _make_qkv(L, B, H, D, "bfloat16")
+
+    def chain(n):
+        def f(a, b, c):
+            def body(_, acc):
+                return flash_attention(
+                    acc, b, c, causal=causal, block_q=bq, block_k=bk
+                ).astype(a.dtype)
+
+            return jax.lax.fori_loop(0, n, body, a)
+
+        return jax.jit(f)
+
+    flops = 4.0 * B * H * L * L * D * (0.5 if causal else 1.0)
+    try:
+        per, chains = _diff_time(chain, (q, k, v), flops / (0.4 * _PEAK))
+    except Exception as e:
+        return {
+            "metric": "flash_fwd_sweep", "seq_len": L, "head_dim": D,
+            "block_q": bq, "block_k": bk, "error": str(e)[:200],
+        }
+    tf = flops / per / 1e12
+    return {
+        "metric": "flash_fwd_sweep",
+        "seq_len": L, "batch": B, "heads": H, "head_dim": D,
+        "causal": causal, "dtype": "bfloat16",
+        "block_q": bq, "block_k": bk,
+        "ms": round(per * 1e3, 3),
+        "tflops": round(tf, 2),
+        "mfu_pct_of_v5e_peak": round(100.0 * tf * 1e12 / _PEAK, 1),
+        "chain_lengths": chains,
+    }
+
+
+def bwd_point(L, D, bq, bk, B=2, H=8, causal=True):
+    """Time flash_bwd_pair alone (both kernels, one call each per
+    iteration) with a fixed realistic lse/delta; the chain feeds
+    dq+dk+dv back as q so nothing DCEs."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorframes_tpu.ops.attention import (
+        _flash_forward,
+        flash_bwd_pair,
+    )
+
+    q, k, v = _make_qkv(L, B, H, D, "bfloat16")
+    bh = B * H
+    qf, kf, vf = (a.reshape(bh, L, D) for a in (q, k, v))
+    # one real forward (at the default tiles) for a consistent lse
+    o, lse = _flash_forward(q, k, v, causal, 1024, 1024, False)
+    dof = jnp.ones((bh, L, D), jnp.bfloat16)
+    delta = (
+        dof.astype(jnp.float32) * o.reshape(bh, L, D).astype(jnp.float32)
+    ).sum(axis=-1, keepdims=True)
+    lse = jax.lax.stop_gradient(lse)
+
+    def chain(n):
+        def f(qq, kk, vv):
+            def body(_, acc):
+                dq, dk, dv = flash_bwd_pair(
+                    acc, kk, vv, dof, lse, delta,
+                    causal=causal, offset=0, block_q=bq, block_k=bk,
+                    interpret=False,
+                    out_dtypes=(jnp.bfloat16,) * 3,
+                )
+                return (dq + dk + dv).astype(acc.dtype)
+
+            return jax.lax.fori_loop(0, n, body, qq)
+
+        return jax.jit(f)
+
+    # bwd pair: 2.5x the forward's matmul volume
+    flops = 2.5 * 4.0 * B * H * L * L * D * (0.5 if causal else 1.0)
+    try:
+        per, chains = _diff_time(chain, (qf, kf, vf), flops / (0.35 * _PEAK))
+    except Exception as e:
+        return {
+            "metric": "flash_bwd_sweep", "seq_len": L, "head_dim": D,
+            "block_q": bq, "block_k": bk, "error": str(e)[:200],
+        }
+    tf = flops / per / 1e12
+    return {
+        "metric": "flash_bwd_sweep",
+        "seq_len": L, "batch": B, "heads": H, "head_dim": D,
+        "causal": causal, "dtype": "bfloat16",
+        "block_q": bq, "block_k": bk,
+        "ms": round(per * 1e3, 3),
+        "tflops": round(tf, 2),
+        "mfu_pct_of_v5e_peak": round(100.0 * tf * 1e12 / _PEAK, 1),
+        "chain_lengths": chains,
+    }
+
+
+def main():
+    quick = "quick" in sys.argv[1:]
+    rows = []
+
+    def emit(r):
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+
+    # hardware ceilings first: what the matmul shapes allow at all
+    emit(matmul_ceiling(64))
+    emit(matmul_ceiling(128))
+
+    # D=64 forward sweep (L=16384 = the r04 28.7% row's regime)
+    L64 = 16384
+    combos64 = [
+        (1024, 1024),  # r04 incumbent
+        (1024, 2048),
+        (2048, 1024),
+        (2048, 2048),
+        (512, 2048),
+        (1024, 4096),
+    ]
+    if quick:
+        combos64 = combos64[:3]
+    for bq, bk in combos64:
+        emit(fwd_point(L64, 64, bq, bk))
+
+    # backward sweep at D=128 (the train-step rows' regime)
+    L128 = 16384
+    combos_bwd = [
+        (1024, 1024),  # incumbent (shared with fwd)
+        (512, 1024),
+        (1024, 512),
+        (512, 2048),
+        (2048, 512),
+        (512, 512),
+    ]
+    if quick:
+        combos_bwd = combos_bwd[:3]
+    for bq, bk in combos_bwd:
+        emit(bwd_point(L128, 128, bq, bk, B=1, H=4))
+
+    # backward at D=64 too (the D=64 train-step target)
+    for bq, bk in ([(1024, 1024), (2048, 1024), (1024, 2048)] if not quick
+                   else [(1024, 1024)]):
+        emit(bwd_point(L64, 64, bq, bk))
+
+    with open(
+        os.path.join(os.path.dirname(__file__), "..", "flash_sweep_r05.json"),
+        "w",
+    ) as f:
+        json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

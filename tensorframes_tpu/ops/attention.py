@@ -303,6 +303,13 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     qf = q.reshape(bh, lq, d)
     kf = k.reshape(bh, lk, d)
     vf = v.reshape(bh, lk, d)
+    # NOTE on D=64 (r05): head-pair packing per grid step was built and
+    # measured — at its VMEM-safe tiles (two f32 score tiles cap it at
+    # bq*bk <= 512k) it reached 24.1% MFU, LOSING to the single-head
+    # kernel at full 1024x1024 tiles (28.7%); tile area beats head
+    # packing, so the variant was removed (flash_sweep4_r05.json). The
+    # D=64 ceiling itself is hardware: the bare matmul pair measures
+    # 59.2% of peak (flash_sweep_r05.json attention_matmul_ceiling).
     kernel = functools.partial(
         _flash_kernel,
         block_q=block_q,
@@ -521,6 +528,19 @@ def _frontier_mask(q_block_idx, k_block_idx, block_q, block_k, offset):
     return q_pos >= k_pos
 
 
+def _frontier_mask_t(q_block_idx, k_block_idx, block_q, block_k, offset):
+    """:func:`_frontier_mask` transposed — the [block_k, block_q] mask
+    for the dkv kernel's transposed-score tiles (same predicate, iota
+    axes swapped so no relayout is spent transposing the mask)."""
+    q_pos = offset + q_block_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 1
+    )
+    k_pos = k_block_idx * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0
+    )
+    return q_pos >= k_pos
+
+
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     *, block_q, block_k, causal, offset, scale,
@@ -584,7 +604,17 @@ def _flash_bwd_dkv_kernel(
 ):
     """dK/dV: grid (batch*heads, k_blocks, q_blocks), q innermost
     sequential; one kernel owns one k tile and streams the q tiles that
-    can see it, accumulating both gradients in VMEM scratch."""
+    can see it, accumulating both gradients in VMEM scratch.
+
+    The math runs in the TRANSPOSED-score formulation: ``s^T = K Q^T``
+    ([bk, bq]) so that all four contractions — s^T, dp^T = V dO^T,
+    dV += p^T dO, dK += ds^T Q — contract over their operands' MINOR
+    axis. The direct formulation needed two axis-0 contractions
+    (``P^T dO``, ``dS^T Q``) whose operand relayouts held this kernel at
+    73% of the matmul ceiling while the dq kernel (all-natural
+    contractions) ran at 93% (r05 per-kernel sweep,
+    flash_sweep2_r05.json). The [bq, 1] lse/delta rows transpose to
+    [1, bq] lane vectors once per tile — trivial next to the matmuls."""
     from jax.experimental import pallas as pl
 
     jk = pl.program_id(1)
@@ -599,26 +629,40 @@ def _flash_bwd_dkv_kernel(
     def compute(with_mask):
         qi = q_ref[0]
         kj = k_ref[0]
+        vj = v_ref[0]
         doi = do_ref[0]
-        mask = (
-            _frontier_mask(iq, jk, block_q, block_k, offset)
-            if with_mask
-            else None
-        )
-        p, ds, mxu_dt = _bwd_tile_terms(
-            qi, kj, v_ref[0], doi, lse_ref[0], delta_ref[0], scale, mask
-        )
-        # contract over the q-row axis: dV += P^T dO, dK += dS^T Q
-        dv_scr[:] += jax.lax.dot_general(
-            p.astype(mxu_dt),
+        mxu_dt = _mxu_dtype(qi.dtype)
+        st = jax.lax.dot_general(
+            kj.astype(mxu_dt),
+            qi.astype(mxu_dt),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (scale * _LOG2E)
+        if with_mask:
+            st = jnp.where(
+                _frontier_mask_t(iq, jk, block_q, block_k, offset),
+                st,
+                _NEG_BIG,
+            )
+        lse_row = lse_ref[0].reshape(1, block_q)
+        pt = jnp.exp2(st - lse_row)  # [bk, bq]; masked rows underflow to 0
+        dpt = jax.lax.dot_general(
+            vj.astype(mxu_dt),
             doi.astype(mxu_dt),
-            dimension_numbers=(((0,), (0,)), ((), ())),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dst = pt * (dpt - delta_ref[0].reshape(1, block_q)) * scale
+        dv_scr[:] += jax.lax.dot_general(
+            pt.astype(mxu_dt),
+            doi.astype(mxu_dt),
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dk_scr[:] += jax.lax.dot_general(
-            ds.astype(mxu_dt),
+            dst.astype(mxu_dt),
             qi.astype(mxu_dt),
-            dimension_numbers=(((0,), (0,)), ((), ())),
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -644,22 +688,60 @@ def _flash_bwd_dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, causal, block_q, block_k, interpret):
+#: measured-best backward tiles per (dtype kind, head_dim bucket) — the
+#: r05 per-kernel sweep (benchmarks/flash_sweep2_r05.py): the dq kernel
+#: (3 matmuls/tile, k innermost) and the dk/dv kernel (4 matmuls/tile, q
+#: innermost) run different matmul mixes and need not share the
+#: forward's optimum. Keys as in ``_BEST_BLOCKS``; values are
+#: ((dq_block_q, dq_block_k), (dkv_block_q, dkv_block_k)).
+_BEST_BLOCKS_BWD = {
+    # dq (3 natural matmuls, k innermost) peaks at square 1024 tiles
+    # (178 TF/s real rate = 93% of ceiling); the transposed-score dkv
+    # kernel prefers narrow-q/wide-k (154 TF/s at 512x2048 vs 146 at
+    # square). flash_sweep2/3_r05.json. f32 inputs DOUBLE every score
+    # intermediate: dkv at 512x2048 f32 needs 26.5 MB of scoped VMEM
+    # (measured compile failure) — the f32 rows keep square tiles.
+    (True, 128): ((1024, 1024), (512, 2048)),
+    (True, 64): ((1024, 1024), (1024, 1024)),
+    (False, 128): ((1024, 1024), (512, 1024)),
+    (False, 64): ((1024, 1024), (512, 1024)),
+}
+
+
+def _best_blocks_bwd(dtype, d, lq, lk):
+    """Measured-best (dq, dkv) tile pairs, clamped so every tile divides
+    its sequence (``_fit_tile``); falls back to the forward tiles when no
+    lane-aligned fit exists."""
+    is_lowp = dtype in (jnp.bfloat16, jnp.float16)
+    d_bucket = 128 if d > 64 else 64
+    (dq_q, dq_k), (kv_q, kv_k) = _BEST_BLOCKS_BWD[(is_lowp, d_bucket)]
+    fit = (
+        _fit_tile(dq_q, lq), _fit_tile(dq_k, lk),
+        _fit_tile(kv_q, lq), _fit_tile(kv_k, lk),
+    )
+    if any(t is None for t in fit):
+        return None
+    return fit
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret, tune_bwd):
     o, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return o
 
 
-def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret, tune_bwd):
     o, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, interpret, res, do):
+def _flash_core_bwd(causal, block_q, block_k, interpret, tune_bwd, res, do):
     """FlashAttention-2 backward: recompute each softmax tile from q/k and
     the saved per-row log-sum-exp, never materializing [L, L]. Two pallas
-    calls — dq accumulates over k tiles, dk/dv over q tiles — with the
-    same causal skip/frontier regimes as the forward."""
+    calls — dq accumulates over k tiles, dk/dv over q tiles — each with
+    its OWN measured-best tiles (``_BEST_BLOCKS_BWD``; the forward tiles
+    are only the fallback when no tuned tile divides the sequence), and
+    the same causal skip/frontier regimes as the forward."""
     q, k, v, o, lse = res
     b, h, lq, d = q.shape
     lk = k.shape[2]
@@ -672,10 +754,18 @@ def _flash_core_bwd(causal, block_q, block_k, interpret, res, do):
     delta = (
         dof.astype(jnp.float32) * o.reshape(bh, lq, d).astype(jnp.float32)
     ).sum(axis=-1, keepdims=True)
+    # caller-supplied tiles are a VMEM knob and must stay binding (a
+    # program sized to fit with small tiles must not OOM in its VJP);
+    # only DEFAULTED tiles consult the tuned backward table
+    tuned = _best_blocks_bwd(q.dtype, d, lq, lk) if tune_bwd else None
+    if tuned is None:
+        tuned = (block_q, block_k, block_q, block_k)
+    dq_q, dq_k, kv_q, kv_k = tuned
     dq, dk, dv = flash_bwd_pair(
         qf, kf, vf, dof, lse, delta,
         causal=causal, offset=lk - lq,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=dq_q, block_k=dq_k, interpret=interpret,
+        dkv_block_q=kv_q, dkv_block_k=kv_k,
         out_dtypes=(q.dtype, k.dtype, v.dtype),
     )
     return (
@@ -688,19 +778,26 @@ def _flash_core_bwd(causal, block_q, block_k, interpret, res, do):
 def flash_bwd_pair(
     qf, kf, vf, dof, lse, delta, *,
     causal, offset, block_q, block_k, interpret, out_dtypes,
+    dkv_block_q=None, dkv_block_k=None,
 ):
     """The two FlashAttention-2 backward pallas calls for one q-span/k-span
     pair, flat [BH, L, D] layout, with the causal diagonal at static
     ``offset``. Shared by the single-chip VJP (offset = lk - lq) and the
     ring backward (per-hop gradients; offset 0 on the diagonal hop).
     ``out_dtypes`` picks the emitted (dq, dk, dv) dtypes — the ring passes
-    f32 so cross-hop accumulation never truncates."""
+    f32 so cross-hop accumulation never truncates. ``dkv_block_*``
+    override the dk/dv kernel's tiles (it prefers wide-q/narrow-k, the
+    transpose of the dq kernel's optimum — see ``_BEST_BLOCKS_BWD``);
+    they default to the dq tiles."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, lq, d = qf.shape
     lk = kf.shape[1]
+    dkv_block_q = dkv_block_q or block_q
+    dkv_block_k = dkv_block_k or block_k
     _check_tiles(block_q, lq, block_k, lk)
+    _check_tiles(dkv_block_q, lq, dkv_block_k, lk)
     scale = 1.0 / float(np.sqrt(d))
     dq_dt, dk_dt, dv_dt = out_dtypes
 
@@ -736,27 +833,27 @@ def flash_bwd_pair(
 
     # k-major grid: index maps swap which grid axis picks the q vs k tile
     qk_q_spec = pl.BlockSpec(
-        (1, block_q, d), lambda bi, ki, qi: (bi, qi, 0),
+        (1, dkv_block_q, d), lambda bi, ki, qi: (bi, qi, 0),
         memory_space=pltpu.VMEM,
     )
     qk_k_spec = pl.BlockSpec(
-        (1, block_k, d), lambda bi, ki, qi: (bi, ki, 0),
+        (1, dkv_block_k, d), lambda bi, ki, qi: (bi, ki, 0),
         memory_space=pltpu.VMEM,
     )
     qk_row_spec = pl.BlockSpec(
-        (1, block_q, 1), lambda bi, ki, qi: (bi, qi, 0),
+        (1, dkv_block_q, 1), lambda bi, ki, qi: (bi, qi, 0),
         memory_space=pltpu.VMEM,
     )
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel,
-            block_q=block_q,
-            block_k=block_k,
+            block_q=dkv_block_q,
+            block_k=dkv_block_k,
             causal=causal,
             offset=offset,
             scale=scale,
         ),
-        grid=(bh, lk // block_k, lq // block_q),
+        grid=(bh, lk // dkv_block_k, lq // dkv_block_q),
         in_specs=[
             qk_q_spec, qk_k_spec, qk_k_spec, qk_q_spec,
             qk_row_spec, qk_row_spec,
@@ -767,8 +864,8 @@ def flash_bwd_pair(
             jax.ShapeDtypeStruct((bh, lk, d), dv_dt),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((dkv_block_k, d), jnp.float32),
+            pltpu.VMEM((dkv_block_k, d), jnp.float32),
         ],
         compiler_params=_dim_semantics(pltpu, interpret),
         interpret=interpret,
@@ -815,6 +912,9 @@ def flash_attention(
     True off-TPU so tests run on CPU."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
+    # explicit tiles are a VMEM knob: they bind the backward too (the
+    # tuned _BEST_BLOCKS_BWD table applies only when tiles defaulted)
+    tune_bwd = block_q is None and block_k is None
     if block_q is None or block_k is None:
         tuned_q, tuned_k = _best_blocks(q.dtype, d, max(lq, lk))
         block_q = block_q or tuned_q
@@ -829,4 +929,6 @@ def flash_attention(
         )
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    return _flash_core(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_core(
+        q, k, v, causal, block_q, block_k, interpret, tune_bwd
+    )
