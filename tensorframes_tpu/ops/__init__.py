@@ -1,13 +1,11 @@
 """Hot-op kernels: Pallas flash attention + ring/Ulysses sequence
-parallelism + fused dense scoring."""
+parallelism."""
 
 from .attention import flash_attention, attention_reference, online_block_update
 from .ring import ring_attention, ring_attention_sharded
-from .scoring import dense_argmax
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
-    "dense_argmax",
     "flash_attention",
     "attention_reference",
     "online_block_update",

@@ -80,58 +80,3 @@ class TestMLPScoring:
         g1 = clf._graph_cache
         clf.score_frame(df, "f").cache()
         assert clf._graph_cache is g1 and len(g1) == 1
-
-
-class TestDenseArgmaxKernel:
-    """The fused pallas scorer must agree exactly with the XLA expression
-    (CPU: interpret mode, f32) across tile/fallback regimes."""
-
-    def test_matches_xla_expression(self):
-        import jax.numpy as jnp
-
-        from tensorframes_tpu.ops.scoring import dense_argmax
-
-        rng = np.random.default_rng(0)
-        for n, k, c in [(2000, 784, 10), (64, 32, 5), (97, 16, 3),
-                        (4096, 100, 200), (8, 4, 2)]:
-            x = rng.normal(size=(n, k)).astype(np.float32)
-            w = rng.normal(size=(k, c)).astype(np.float32)
-            b = rng.normal(size=(c,)).astype(np.float32)
-            got = np.asarray(
-                dense_argmax(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
-            )
-            exp = np.asarray(
-                jnp.argmax(jnp.asarray(x) @ jnp.asarray(w) + b, axis=-1)
-            )
-            np.testing.assert_array_equal(got, exp, err_msg=f"{(n, k, c)}")
-            assert got.dtype == np.int32
-
-    def test_no_bias(self):
-        import jax.numpy as jnp
-
-        from tensorframes_tpu.ops.scoring import dense_argmax
-
-        rng = np.random.default_rng(1)
-        x = rng.normal(size=(256, 64)).astype(np.float32)
-        w = rng.normal(size=(64, 7)).astype(np.float32)
-        got = np.asarray(dense_argmax(jnp.asarray(x), jnp.asarray(w)))
-        np.testing.assert_array_equal(
-            got, np.asarray(jnp.argmax(jnp.asarray(x) @ w, axis=-1))
-        )
-
-    def test_single_layer_classifier_uses_it(self):
-        """score_frame end to end: the engine path through the kernel."""
-        import tensorframes_tpu as tft
-        from tensorframes_tpu.models import MLPClassifier
-
-        rng = np.random.default_rng(2)
-        x = rng.normal(size=(500, 32)).astype(np.float32)
-        clf = MLPClassifier.init(0, [32, 6])
-        df = tft.TensorFrame.from_columns({"features": x}).analyze()
-        out = clf.score_frame(df, "features")
-        preds = np.asarray(out.column_data("prediction").host())
-        w, b = clf.params[0]["w"], clf.params[0]["b"]
-        import jax.numpy as jnp
-
-        exp = np.asarray(jnp.argmax(jnp.asarray(x) @ w + b, axis=-1))
-        np.testing.assert_array_equal(preds, exp)
