@@ -214,6 +214,24 @@ def main():
                     "bf16_hbm_bandwidth_util": round(
                         xb.nbytes / dt_bf16 / _V5E_HBM_BYTES_PER_S, 4
                     ),
+                    # two-point decomposition from THIS run's f32/bf16
+                    # pair: t = bytes/BW + c, where c is the
+                    # dtype-independent fixed term (the [784,10]->[784,
+                    # 128] lane-padded matmul, ~1ms of MXU time, which a
+                    # pallas overlap attempt could not beat — see
+                    # docs/perf.md). The raw bf16 utilization above is an
+                    # amortization artifact of c over half the bytes;
+                    # the STREAM itself runs at this fraction of peak in
+                    # both modes:
+                    "derived_stream_bandwidth_util": round(
+                        (x.nbytes - xb.nbytes)
+                        / (dt_pipeline - dt_bf16)
+                        / _V5E_HBM_BYTES_PER_S,
+                        4,
+                    ),
+                    "derived_fixed_mxu_ms": round(
+                        (2 * dt_bf16 - dt_pipeline) * 1e3, 3
+                    ),
                     "host_pipelined_rows_per_sec": round(n_rows / dt_host_pipe, 1),
                     "host_sequential_rows_per_sec": round(n_rows / dt_host_seq, 1),
                     "framework_overhead_ms_per_pass": round(overhead_ms, 3),
