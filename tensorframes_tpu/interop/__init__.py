@@ -7,6 +7,7 @@ Arrow record batches (SURVEY §2.4).
 """
 
 from .arrow import from_arrow, to_arrow
+from .serving import ScoringServer, remote_arrow_mapper, remote_map_in_arrow
 from .spark import from_spark, to_spark, spark_available
 from .weights import (
     load_weights,
@@ -24,6 +25,9 @@ __all__ = [
     "from_spark",
     "to_spark",
     "spark_available",
+    "ScoringServer",
+    "remote_arrow_mapper",
+    "remote_map_in_arrow",
     "load_weights",
     "save_weights",
     "flatten_tree",

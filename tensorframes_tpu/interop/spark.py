@@ -124,7 +124,12 @@ def arrow_batch_mapper(
     from .arrow import from_arrow, to_arrow
 
     def run(table):
-        df = from_arrow(table)
+        # analyze() pins vector/tensor cell shapes before capture: a
+        # FixedSizeList column ingested without it leaves Unknown cell
+        # dims, and the capture probe would trace the program at a
+        # placeholder width (wrong shapes or a confusing trace error).
+        # Dense columns analyze from shape metadata — no cell scan.
+        df = from_arrow(table).analyze()
         out = engine.map_blocks(
             fetches,
             df,
