@@ -702,7 +702,7 @@ _BEST_BLOCKS_BWD = {
     # intermediate: dkv at 512x2048 f32 needs 26.5 MB of scoped VMEM
     # (measured compile failure) — the f32 rows keep square tiles.
     (True, 128): ((1024, 1024), (512, 2048)),
-    (True, 64): ((1024, 1024), (1024, 1024)),
+    (True, 64): ((1024, 1024), (512, 2048)),
     (False, 128): ((1024, 1024), (512, 1024)),
     (False, 64): ((1024, 1024), (512, 1024)),
 }
