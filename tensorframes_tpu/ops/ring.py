@@ -158,14 +158,25 @@ def _ring_fwd_loop(
 def _bwd_hop_branches(qf, dof, lse, delta, bq, bk, interpret, d):
     """The three backward hop bodies: each returns this hop's
     (dq, dk, dv) contributions in f32 (zeros for the skipped regime)."""
+    from .attention import _best_blocks_bwd
+
     f32 = (jnp.float32, jnp.float32, jnp.float32)
+
+    # the dkv kernel's own measured-best tiles (the transposed-score
+    # kernel prefers narrow-q/wide-k — _BEST_BLOCKS_BWD) when they fit
+    # the hop spans; the hop's fitted tiles otherwise
+    def _kv_tiles(lc):
+        tuned = _best_blocks_bwd(qf.dtype, d, qf.shape[1], lc)
+        return (tuned[2], tuned[3]) if tuned is not None else (bq, bk)
 
     def pair(causal):
         def run(args):
             kc, vc = args
+            dkv_q, dkv_k = _kv_tiles(kc.shape[1])
             return flash_bwd_pair(
                 qf, kc, vc, dof, lse, delta,
                 causal=causal, offset=0, block_q=bq, block_k=bk,
+                dkv_block_q=dkv_q, dkv_block_k=dkv_k,
                 interpret=interpret, out_dtypes=f32,
             )
 
