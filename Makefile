@@ -1,9 +1,12 @@
 # Developer entry points (the reference ships sbt + python/run-tests.sh,
 # /root/reference/project/Build.scala:8-127, python/run-tests.sh:28-117).
 
+# `verify` uses bash arrays/PIPESTATUS; make the whole file consistent
+SHELL := /bin/bash
+
 PY ?= python
 
-.PHONY: test test-fast test-multihost bench bench-all bench-attention dryrun install lint
+.PHONY: test test-fast test-multihost verify bench bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -14,9 +17,16 @@ test:
 
 # the edit-test loop tier: everything not marked slow, parallelized;
 # target < 3 min (the slow marks carry the multi-process / training
-# heavyweights — CI runs `test-fast` on PRs and `test` on merges)
+# heavyweights — CI runs `test-fast` on PRs and `test` on merges).
+# pytest-xdist is enabled by its -n flag alone (`-p xdist` is not how the
+# plugin is selected and broke on installs that auto-load it).
 test-fast:
-	$(PY) -m pytest tests/ -q -m "not slow" -p xdist -n 4
+	$(PY) -m pytest tests/ -q -m "not slow" -n 4
+
+# the EXACT ROADMAP tier-1 command (what the driver measures after each
+# PR) — run this before shipping so local numbers match CI's
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # just the real 2-process distributed suite
 test-multihost:

@@ -68,7 +68,7 @@ from .capture import (
     functions,
 )
 from .builder import OpBuilder
-from . import schema, utils
+from . import obs, schema, utils
 
 __all__ = [
     # the reference's nine public functions (core.py:11-12)
@@ -105,6 +105,7 @@ __all__ = [
     "load_graph",
     "functions",
     "OpBuilder",
+    "obs",
     "schema",
     "utils",
     # errors

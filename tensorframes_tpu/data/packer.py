@@ -18,7 +18,18 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs.metrics import counter as _counter
 from ..utils import get_logger
+
+#: which implementation served each packer kernel call — the fleet-level
+#: answer to "is this host actually running the native hot loops, or did
+#: the toolchain silently fall back to numpy?" (``path``: native |
+#: native_list | native_buffer | fallback)
+_m_kernel_calls = _counter(
+    "packer.kernel_calls_total",
+    "Packer kernel invocations, by kernel and implementation path",
+    labels=("kernel", "path"),
+)
 
 __all__ = [
     "native_available",
@@ -269,6 +280,7 @@ def pad_ragged(
     lib = _load()
     pad = np.asarray(pad_value, dtype=flat.dtype)
     if lib is not None:
+        _m_kernel_calls.inc(kernel="pad_ragged", path="native")
         fn = (
             lib.tfs_par_pad_ragged
             if out.nbytes >= _PAR_THRESHOLD_BYTES
@@ -279,6 +291,7 @@ def pad_ragged(
             _ptr(pad.reshape(1)), _ptr(out),
         )
         return out
+    _m_kernel_calls.inc(kernel="pad_ragged", path="fallback")
     out[:] = pad
     for i in range(n):
         row = flat[offsets[i] : offsets[i + 1]]
@@ -306,11 +319,13 @@ def unpad_ragged(padded: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     out = np.empty(total, dtype=padded.dtype)
     lib = _load()
     if lib is not None:
+        _m_kernel_calls.inc(kernel="unpad_ragged", path="native")
         lib.tfs_unpad_ragged(
             _ptr(padded), _i64ptr(lengths), padded.shape[0],
             padded.shape[1], padded.dtype.itemsize, _ptr(out),
         )
         return out
+    _m_kernel_calls.inc(kernel="unpad_ragged", path="fallback")
     off = 0
     for i, ln in enumerate(lengths):
         out[off : off + ln] = padded[i, :ln]
@@ -334,6 +349,7 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     out = np.empty((len(idx),) + src.shape[1:], dtype=src.dtype)
     lib = _load()
     if lib is not None and src.ndim >= 1:
+        _m_kernel_calls.inc(kernel="gather_rows", path="native")
         row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.dtype.itemsize
         fn = (
             lib.tfs_par_gather_rows
@@ -342,6 +358,7 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
         )
         fn(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))
         return out
+    _m_kernel_calls.inc(kernel="gather_rows", path="fallback")
     return src[idx]
 
 
@@ -356,6 +373,7 @@ def scatter_rows(src: np.ndarray, idx: np.ndarray, n_rows: int) -> np.ndarray:
     out = np.empty((n_rows,) + src.shape[1:], dtype=src.dtype)
     lib = _load()
     if lib is not None:
+        _m_kernel_calls.inc(kernel="scatter_rows", path="native")
         row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.dtype.itemsize
         # the pooled scatter would race on duplicate targets (the serial
         # kernel is deterministic last-wins), so it is reserved for
@@ -371,6 +389,7 @@ def scatter_rows(src: np.ndarray, idx: np.ndarray, n_rows: int) -> np.ndarray:
             fn = lib.tfs_par_scatter_rows
         fn(_ptr(src), row_bytes, _i64ptr(idx), len(idx), _ptr(out))
         return out
+    _m_kernel_calls.inc(kernel="scatter_rows", path="fallback")
     out[idx] = src
     return out
 
@@ -402,6 +421,7 @@ def gather_ragged_pad(
     lib = _load()
     pad = np.asarray(pad_value, dtype=flat.dtype)
     if lib is not None:
+        _m_kernel_calls.inc(kernel="gather_ragged_pad", path="native")
         fn = (
             lib.tfs_par_gather_ragged_pad
             if out.nbytes >= _PAR_THRESHOLD_BYTES
@@ -412,6 +432,7 @@ def gather_ragged_pad(
             int(max_len), flat.dtype.itemsize, _ptr(pad.reshape(1)), _ptr(out),
         )
         return out
+    _m_kernel_calls.inc(kernel="gather_ragged_pad", path="fallback")
     out[:] = pad
     for k, i in enumerate(idx):
         row = flat[offsets[i] : offsets[i + 1]]
@@ -445,15 +466,19 @@ def code_keys(cells) -> Optional[np.ndarray]:
             cells, codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         )
         if got >= 0:
+            _m_kernel_calls.inc(kernel="code_keys", path="native_list")
             return codes
         if got != -2:  # -2 = non-bytes cell; try the buffer path
+            _m_kernel_calls.inc(kernel="code_keys", path="fallback")
             return None
     lib = _load()
     if lib is None:
+        _m_kernel_calls.inc(kernel="code_keys", path="fallback")
         return None
     try:
         buf = b"".join(cells)
     except TypeError:
+        _m_kernel_calls.inc(kernel="code_keys", path="fallback")
         return None
     lengths = np.fromiter(
         (len(c) for c in cells), dtype=np.int64, count=n
@@ -465,5 +490,7 @@ def code_keys(cells) -> Optional[np.ndarray]:
         codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     if got < 0:
+        _m_kernel_calls.inc(kernel="code_keys", path="fallback")
         return None
+    _m_kernel_calls.inc(kernel="code_keys", path="native_buffer")
     return codes

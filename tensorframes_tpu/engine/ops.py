@@ -37,8 +37,11 @@ from ..capture import CapturedGraph, Node, TensorSpec, build_graph
 from ..capture import dsl as _dsl
 from ..frame import GroupedFrame, TensorFrame
 from ..frame.table import _build_column, _ColumnData
+from ..obs import span as _span
+from ..obs.metrics import counter as _counter
 from ..schema import ColumnInfo, FrameInfo, Shape, Unknown
 from ..utils import ensure_x64, get_logger
+from ..utils.failures import record_oom_split
 from .validation import (
     InputNotFoundError,
     InvalidDimensionError,
@@ -75,6 +78,44 @@ _callable_graphs: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 #: signature) on recompile churn from lambdas recreated per call
 _seen_callable_codes: set = set()
 _warned_callable_codes: set = set()
+
+# -- engine telemetry (tensorframes_tpu.obs; docs/observability.md) ---------
+_m_graph_hits = _counter(
+    "engine.graph_memo_hits_total",
+    "Callable-frontend captures resolved from the per-function memo",
+)
+_m_graph_misses = _counter(
+    "engine.graph_memo_misses_total",
+    "Callable-frontend captures that traced a fresh CapturedGraph",
+)
+_m_recapture = _counter(
+    "engine.callable_recapture_total",
+    "Re-captures of identical code under a new function identity "
+    "(recompile churn: a lambda recreated per call)",
+)
+_m_jit_builds = _counter(
+    "engine.jit_cache_builds_total",
+    "jax.jit wrappers built for a CapturedGraph (first use)",
+)
+_m_jit_reuse = _counter(
+    "engine.jit_cache_reuse_total",
+    "Engine calls that reused a CapturedGraph's existing jit wrapper",
+)
+_m_rows = _counter(
+    "engine.rows_processed_total",
+    "Input rows processed, by op",
+    labels=("op",),
+)
+_m_blocks = _counter(
+    "engine.blocks_processed_total",
+    "Device dispatches (partition blocks / row chunks), by op",
+    labels=("op",),
+)
+# pre-bound series for the dispatch loops (label resolution paid once)
+_m_blocks_map_blocks = _m_blocks.bind(op="map_blocks")
+_m_blocks_map_rows = _m_blocks.bind(op="map_rows")
+_m_rows_map_blocks = _m_rows.bind(op="map_blocks")
+_m_rows_map_rows = _m_rows.bind(op="map_rows")
 
 
 # ---------------------------------------------------------------------------
@@ -207,7 +248,9 @@ def _graph_from_callable(
     except TypeError:  # unhashable/unweakrefable callables skip the cache
         per_fn = {}
     if cache_key in per_fn:
+        _m_graph_hits.inc()
         return per_fn[cache_key]
+    _m_graph_misses.inc()
     # capture is memoized by FUNCTION IDENTITY; a lambda recreated inside a
     # loop has the same code but a new identity every pass, silently
     # recompiling its programs. Detect the churn and tell the user once.
@@ -222,6 +265,10 @@ def _graph_from_callable(
     ):
         code_key = (code, cache_key)
         if code_key in _seen_callable_codes:
+            # the log line fires once per signature; the counter counts
+            # EVERY recapture, so churn magnitude stays measurable after
+            # the warning has been emitted
+            _m_recapture.inc()
             if code_key not in _warned_callable_codes:
                 _warned_callable_codes.add(code_key)
                 logger.warning(
@@ -252,6 +299,9 @@ def _jitted(g: CapturedGraph):
 
         j = jax.jit(g.fn)
         g._jit_cache = j
+        _m_jit_builds.inc()
+    else:
+        _m_jit_reuse.inc()
     return j
 
 
@@ -262,6 +312,9 @@ def _jitted_vmap(g: CapturedGraph):
 
         j = jax.jit(jax.vmap(g.fn))
         g._jit_vmap_cache = j
+        _m_jit_builds.inc()
+    else:
+        _m_jit_reuse.inc()
     return j
 
 
@@ -489,7 +542,7 @@ def map_blocks(
         ph: np.asarray(v) for ph, v in (constants or {}).items()
     }
 
-    def thunk() -> TensorFrame:
+    def _run() -> TensorFrame:
         from ..utils import get_config
 
         pieces: Dict[str, List] = {n: [] for n in fetch_names}
@@ -603,6 +656,7 @@ def map_blocks(
             so a lost async result re-runs only its own partition."""
             lo, hi = bounds[p]
             n = hi - lo
+            _m_blocks_map_blocks.inc()
             feed = {ph: feeders[ph](lo, hi) for ph in binding}
             feed.update(const_feed)
             from ..utils import is_oom, run_with_retries
@@ -766,6 +820,16 @@ def map_blocks(
             cols[c.name] = parent.column_data(c.name)
         return TensorFrame(cols, result_info, offsets=offsets)
 
+    def thunk() -> TensorFrame:
+        with _span(
+            "engine.map_blocks", partitions=parent.num_partitions, trim=trim
+        ) as sp:
+            out = _run()
+            if sp is not None:
+                sp.attrs["rows"] = parent.num_rows
+        _m_rows_map_blocks.inc(parent.num_rows)
+        return out
+
     return TensorFrame(
         {}, result_info, num_partitions=parent.num_partitions, _thunk=thunk
     )
@@ -844,17 +908,20 @@ def precompile(
     }
     jit_fn = _jitted(g)
     compiled = 0
-    for n in sorted(set(block_rows)):
-        feed = {
-            ph: jax.ShapeDtypeStruct(
-                (n, *schema[col].cell_shape.dims),
-                schema[col].scalar_type.np_dtype,
-            )
-            for ph, col in binding.items()
-        }
-        feed.update(const_specs)
-        jit_fn.lower(feed).compile()
-        compiled += 1
+    with _span("engine.precompile") as sp:
+        for n in sorted(set(block_rows)):
+            feed = {
+                ph: jax.ShapeDtypeStruct(
+                    (n, *schema[col].cell_shape.dims),
+                    schema[col].scalar_type.np_dtype,
+                )
+                for ph, col in binding.items()
+            }
+            feed.update(const_specs)
+            jit_fn.lower(feed).compile()
+            compiled += 1
+        if sp is not None:
+            sp.attrs["programs"] = compiled
     return compiled
 
 
@@ -963,6 +1030,7 @@ def _map_rows_thunk(
         from ..utils import is_oom, run_with_retries
 
         def run_chunk(sub):
+            _m_blocks_map_rows.inc()
             idx_arr = np.asarray(sub, dtype=np.int64)
             contiguous = bool(
                 idx_arr.size
@@ -999,6 +1067,7 @@ def _map_rows_thunk(
                 # (unlike a map_blocks partition); recurse down to 1 row
                 if is_oom(e):
                     if len(sub) > 1:
+                        record_oom_split("map_rows")
                         logger.warning(
                             "map_rows chunk of %d rows exhausted device "
                             "memory; halving", len(sub),
@@ -1083,6 +1152,7 @@ def _map_rows_thunk(
                 probe_size = fast_chunk if fast_chunk > chunk else None
                 while lo < n:
                     hi = min(lo + fast_chunk, n)
+                    _m_blocks_map_rows.inc()
                     feed = {ph: feeders[ph](lo, hi) for ph in binding}
                     try:
                         res = run_bucket(feed, hi - lo)
@@ -1098,6 +1168,7 @@ def _map_rows_thunk(
                             probe_size = None
                     except Exception as e:
                         if is_oom(e) and fast_chunk > chunk:
+                            record_oom_split("map_rows")
                             fast_chunk = max(chunk, fast_chunk // 2)
                             if fast_chunk <= chunk:
                                 reached_cap[0] = True
@@ -1132,6 +1203,7 @@ def _map_rows_thunk(
                     # whole pass at the row cap, keeping device residency
                     # (skipped when the pass already halved to the cap and
                     # still OOMed — a repeat would fail the same way)
+                    record_oom_split("map_rows")
                     logger.warning(
                         "map_rows byte-capped pass exhausted device "
                         "memory past the probe; retrying device-resident "
@@ -1180,7 +1252,15 @@ def _map_rows_thunk(
         )
         return TensorFrame(cols, result_info, offsets=offsets)
 
-    return thunk
+    def instrumented() -> TensorFrame:
+        with _span("engine.map_rows") as sp:
+            out = thunk()
+            if sp is not None:
+                sp.attrs["rows"] = parent.num_rows
+        _m_rows_map_rows.inc(parent.num_rows)
+        return out
+
+    return instrumented
 
 
 def apply_decoders(
@@ -1306,6 +1386,16 @@ def map_rows(
             )
             return TensorFrame(cols, result_info, offsets=offsets)
 
+        _host_run = thunk
+
+        def thunk() -> TensorFrame:
+            with _span("engine.map_rows", host=True) as sp:
+                out = _host_run()
+                if sp is not None:
+                    sp.attrs["rows"] = parent.num_rows
+            _m_rows.inc(parent.num_rows, op="map_rows_host")
+            return out
+
     else:
         thunk = _map_rows_thunk(
             parent,
@@ -1347,6 +1437,13 @@ def reduce_blocks(fetches, dframe: TensorFrame):
     run per partition block, then a fixed ``[2, ...]`` merge program folds
     the partials — replacing the reference's executors→driver funnel
     (``DebugRowOps.scala:503-526``)."""
+    with _span("engine.reduce_blocks", partitions=dframe.num_partitions):
+        out = _reduce_blocks_impl(fetches, dframe)
+    _m_rows.inc(dframe.num_rows, op="reduce_blocks")
+    return out
+
+
+def _reduce_blocks_impl(fetches, dframe: TensorFrame):
     g = _as_graph(fetches, dframe, cell_inputs=False)
     binding = validate_reduce_block_graph(g, dframe.schema)
     _ensure_precision(g, dframe.schema)
@@ -1407,6 +1504,7 @@ def reduce_blocks(fetches, dframe: TensorFrame):
         )
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
+    _m_blocks.inc(len(partials), op="reduce_blocks")
     import jax.numpy as jnp
 
     acc = partials[0]
@@ -1426,6 +1524,13 @@ def reduce_rows(fetches, dframe: TensorFrame):
     ``performReducePairwise``, ``DebugRowOps.scala:930-969``, with the
     session loop compiled away); across partitions the same merge program
     folds the partials."""
+    with _span("engine.reduce_rows", partitions=dframe.num_partitions):
+        out = _reduce_rows_impl(fetches, dframe)
+    _m_rows.inc(dframe.num_rows, op="reduce_rows")
+    return out
+
+
+def _reduce_rows_impl(fetches, dframe: TensorFrame):
     g = _as_graph(fetches, dframe, cell_inputs=True)
     binding = validate_reduce_row_graph(g, dframe.schema)
     _ensure_precision(g, dframe.schema)
@@ -1476,6 +1581,7 @@ def reduce_rows(fetches, dframe: TensorFrame):
         partials.append(fold_block(feed))
     if not partials:
         raise ValueError("reduce_rows on an empty frame")
+    _m_blocks.inc(len(partials), op="reduce_rows")
     acc = partials[0]
     for part in partials[1:]:
         acc = merge_jit(acc, part)
@@ -1794,6 +1900,15 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
     scalars, binary cells, or multi-column mixes (reference
     ``DebugRowOps.scala:547-592``).
     """
+    # chunked aggregates recurse through this wrapper on their partial
+    # tables, so nested spans (and per-pass row counts) show the recursion
+    with _span("engine.aggregate", keys=",".join(grouped_data.keys)):
+        out = _aggregate_impl(fetches, grouped_data)
+    _m_rows.inc(grouped_data.frame.num_rows, op="aggregate")
+    return out
+
+
+def _aggregate_impl(fetches, grouped_data: GroupedFrame) -> TensorFrame:
     dframe = grouped_data.frame
     keys = grouped_data.keys
     if not keys:

@@ -29,6 +29,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 import numpy as np
 
+from ..obs import span as _span
+from ..obs.metrics import counter as _counter
 from ..schema import (
     BINARY,
     ColumnInfo,
@@ -39,6 +41,17 @@ from ..schema import (
 )
 
 __all__ = ["Row", "TensorFrame", "GroupedFrame", "frame_from_pandas"]
+
+#: link-traffic accounting at the two memoized transfer points
+#: (``_ColumnData.device()`` / ``host()``) — each column crosses at most
+#: once per direction, so these measure real bytes over the PCIe/tunnel
+#: link, not access counts
+_m_h2d = _counter(
+    "frame.h2d_bytes_total", "Host-to-device column transfer bytes"
+)
+_m_d2h = _counter(
+    "frame.d2h_bytes_total", "Device-to-host column transfer bytes"
+)
 
 
 class Row(dict):
@@ -115,6 +128,7 @@ class _ColumnData:
             import jax
 
             self._device_arr = jax.device_put(self.dense)
+            _m_h2d.inc(self.dense.nbytes)
         return self._device_arr
 
     def host(self) -> np.ndarray:
@@ -126,6 +140,7 @@ class _ColumnData:
             return self.dense
         if self._host_arr is None:
             self._host_arr = np.asarray(self.dense)
+            _m_d2h.inc(self._host_arr.nbytes)
         return self._host_arr
 
     @property
@@ -294,6 +309,10 @@ class TensorFrame:
             return self
         with self._thunk_lock:
             if self._thunk is not None:
+                # no span here: every engine thunk opens its own op span
+                # (engine.map_blocks / engine.map_rows), so a force span
+                # would only duplicate the tree one level up — and _force
+                # sits on every data access
                 concrete = self._thunk()._force()
                 self._columns = concrete._columns
                 self._num_rows = concrete._num_rows
@@ -373,12 +392,13 @@ class TensorFrame:
     def collect(self) -> List[Row]:
         """Materialize to a list of rows (reference ``df.collect()``)."""
         self._force()
-        names = self.columns
-        iters = [self._columns[n].iter_cells() for n in names]
-        out = []
-        for vals in zip(*iters):
-            out.append(Row(zip(names, vals)))
-        return out
+        with _span("frame.collect", rows=self._num_rows):
+            names = self.columns
+            iters = [self._columns[n].iter_cells() for n in names]
+            out = []
+            for vals in zip(*iters):
+                out.append(Row(zip(names, vals)))
+            return out
 
     def to_pandas(self):
         import pandas as pd

@@ -29,15 +29,78 @@ buffered host-side until the request stream ends — full-duplex streaming
 would deadlock clients (like Spark's mapInArrow generator) that write
 everything before reading anything. ``streaming=True`` still bounds the
 server's FRAME memory by running row-local programs per incoming batch.
+
+Observability: the same port doubles as a Prometheus scrape target. A
+connection whose first bytes are ``GET `` is answered as a plain HTTP
+request — ``GET /metrics`` returns the process-wide registry in
+exposition format (an Arrow IPC stream can never start with ``GET ``,
+so the two protocols cannot be confused). Each scoring connection
+increments ``serving.requests_total{kind,status}``, the byte counters,
+and the ``serving.request_seconds`` latency histogram; concurrent load
+shows up on the ``serving.active_connections`` gauge. See
+``docs/observability.md``.
 """
 
 from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..obs import span as _span
+from ..obs.metrics import (
+    counter as _counter,
+    enabled as _obs_enabled,
+    gauge as _gauge,
+    histogram as _histogram,
+    render_prometheus as _render_prometheus,
+)
+
 __all__ = ["ScoringServer", "remote_arrow_mapper", "remote_map_in_arrow"]
+
+_m_requests = _counter(
+    "serving.requests_total",
+    "Connections served, by kind (score|metrics) and terminal status",
+    labels=("kind", "status"),
+)
+_m_bytes_in = _counter(
+    "serving.bytes_in_total", "Request payload bytes read off the wire"
+)
+_m_bytes_out = _counter(
+    "serving.bytes_out_total", "Response payload bytes written to the wire"
+)
+_m_latency = _histogram(
+    "serving.request_seconds",
+    "Scoring request wall time, accept to response flush (seconds)",
+)
+_m_active = _gauge(
+    "serving.active_connections", "Connections currently being served"
+)
+
+
+class _CountingFile:
+    """File-object wrapper that counts bytes through ``read``/``write``
+    into a counter; everything else delegates. pyarrow's IPC reader/writer
+    drive Python file-likes through exactly these two calls."""
+
+    def __init__(self, f, counter):
+        self._f = f
+        self._c = counter
+
+    def read(self, *args, **kwargs):
+        b = self._f.read(*args, **kwargs)
+        if b:
+            self._c.inc(len(b))
+        return b
+
+    def write(self, data):
+        n = self._f.write(data)
+        self._c.inc(len(data) if n is None else n)
+        return n
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
 
 
 class ScoringServer:
@@ -161,24 +224,101 @@ class ScoringServer:
                 target=self._serve_one, args=(conn,), daemon=True
             ).start()
 
+    @staticmethod
+    def _peek(conn: socket.socket) -> bytes:
+        """The request's first bytes without consuming them (so the Arrow
+        reader still sees a whole stream). Blocks for the FIRST byte just
+        like the pre-scrape server blocked in the Arrow parser — a slow
+        client must not be dropped. Waits for more bytes ONLY while the
+        prefix is still ambiguous with ``b"GET "`` (an Arrow stream's
+        first byte is never ``G``, so Arrow clients route immediately);
+        that disambiguation wait is bounded so a client wedged exactly at
+        ``b"GE"`` falls through to the Arrow path — the same failure
+        surface it would have hit before the scrape existed."""
+        buf = conn.recv(4, socket.MSG_PEEK)  # blocking first-byte wait
+        if not buf or not b"GET ".startswith(buf[:4]):
+            return buf
+        deadline = time.monotonic() + 10.0
+        while len(buf) < 4 and b"GET ".startswith(buf):
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.005)
+            buf = conn.recv(4, socket.MSG_PEEK)
+            if not buf:
+                break
+        return buf
+
+    def _serve_metrics(self, conn: socket.socket) -> None:
+        """Answer a plain-HTTP request on the Arrow port: ``GET /metrics``
+        returns the default registry in Prometheus exposition format, so
+        ``curl http://host:port/metrics`` (or an actual Prometheus scrape
+        job) works against a live scoring server with no sidecar."""
+        conn.settimeout(10)
+        head = b""
+        while b"\r\n\r\n" not in head and len(head) < 8192:
+            chunk = conn.recv(4096)
+            if not chunk:
+                break
+            head += chunk
+        line = head.split(b"\r\n", 1)[0].decode("latin-1", "replace")
+        parts = line.split()
+        path = parts[1] if len(parts) > 1 else "/"
+        if path.split("?", 1)[0] in ("/metrics", "/metrics/"):
+            body = _render_prometheus().encode("utf-8")
+            status = "200 OK"
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        else:
+            body = b"scrape endpoint: GET /metrics\n"
+            status = "404 Not Found"
+            ctype = "text/plain; charset=utf-8"
+        conn.sendall(
+            (
+                f"HTTP/1.1 {status}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode("latin-1")
+            + body
+        )
+
     def _serve_one(self, conn: socket.socket) -> None:
         import pyarrow as pa
 
         from ..utils import get_logger
 
+        t0 = time.perf_counter()
+        kind, status = "score", "ok"
+        # one gate snapshot for the inc/dec PAIR: a kill-switch flip while
+        # this request is in flight must not strand the gauge
+        tracked = _obs_enabled()
+        if tracked:
+            _m_active.adjust(1.0)
         try:
             with conn:
+                first = self._peek(conn)
+                if not first:
+                    # client connected and went away without a request
+                    status = "empty"
+                    return
+                if first == b"GET ":
+                    kind = "metrics"
+                    try:
+                        self._serve_metrics(conn)
+                    except OSError:
+                        status = "error"
+                    return
                 wf = None
                 try:
-                    rf = conn.makefile("rb")
+                    rf = _CountingFile(conn.makefile("rb"), _m_bytes_in)
                     reader = pa.ipc.open_stream(rf)
                     # results buffer until the request stream ends: a
                     # client that writes its whole partition before
                     # reading (Spark's mapInArrow generator does) must
                     # never deadlock against our send buffer
-                    out_batches = list(self._mapper(reader))
+                    with _span("serving.request", peer=conn.getpeername()[0]):
+                        out_batches = list(self._mapper(reader))
                     conn.shutdown(socket.SHUT_RD)
-                    wf = conn.makefile("wb")
+                    wf = _CountingFile(conn.makefile("wb"), _m_bytes_out)
                     # response = 1 status byte, then the payload: \x00 +
                     # Arrow stream, or \x01 + utf-8 error text (the
                     # executor re-raises it as its task failure — engine
@@ -195,6 +335,7 @@ class ScoringServer:
                             pass
                     wf.flush()
                 except Exception as e:
+                    status = "error"
                     get_logger("interop.serving").warning(
                         "scoring connection failed", exc_info=True
                     )
@@ -235,10 +376,16 @@ class ScoringServer:
                     except OSError:
                         pass
         except Exception:
+            status = "error"
             get_logger("interop.serving").warning(
                 "scoring connection teardown failed", exc_info=True
             )
         finally:
+            if tracked:
+                _m_active.adjust(-1.0)
+            _m_requests.inc(kind=kind, status=status)
+            if kind == "score" and status != "empty":
+                _m_latency.observe(time.perf_counter() - t0)
             self._limit.release()
 
 

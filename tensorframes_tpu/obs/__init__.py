@@ -1,0 +1,58 @@
+"""Observability: the metrics registry and span tracing.
+
+The reference delegated all runtime visibility to Spark's UI and task
+metrics; this package is the standalone replacement — counters/gauges/
+histograms (:mod:`.metrics`) and nested spans with a JSONL event log and
+Perfetto forwarding (:mod:`.tracing`). The engine, frame, serving,
+failure, and packer layers publish into the default registry at module
+import; ``ScoringServer`` exports it as a Prometheus scrape on its Arrow
+port (``GET /metrics``). See ``docs/observability.md`` for the metric
+catalog and span conventions.
+
+Kill switch: ``TFT_OBS=0`` in the environment, or
+``tft.utils.set_config(observability=False)``.
+"""
+
+from .metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    enabled,
+    gauge,
+    histogram,
+    registry,
+    render_prometheus,
+    snapshot,
+)
+from .tracing import (
+    Span,
+    current_span,
+    set_annotations,
+    set_trace_sink,
+    span,
+    trace_sink,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_BUCKETS",
+    "counter",
+    "gauge",
+    "histogram",
+    "registry",
+    "snapshot",
+    "render_prometheus",
+    "enabled",
+    "Span",
+    "span",
+    "current_span",
+    "set_annotations",
+    "set_trace_sink",
+    "trace_sink",
+]

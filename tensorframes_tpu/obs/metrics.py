@@ -1,0 +1,433 @@
+"""Thread-safe metrics registry: counters, gauges, log-bucket histograms.
+
+The reference delegated all runtime visibility to Spark's UI and task
+metrics (SURVEY §5: timing was "manual prints in ignored suites"); this
+registry is the replacement signal path for an engine that has no Spark
+around it. Design constraints, in order:
+
+1. **hot-path cheap** — instrumentation sits inside the engine's dispatch
+   loops and the serving accept path, so a disabled registry must cost one
+   predicate and an enabled increment one lock + dict update. Metrics are
+   created once at module import and held in module globals by their
+   instrumenting module (no name lookup per increment).
+2. **thread-safe** — the scoring server increments from its connection
+   pool, the engine from decode/prefetch threads; every series mutation
+   happens under its metric's lock.
+3. **two export shapes** — ``snapshot()`` returns a plain dict (JSON-able,
+   for tests/logging/BENCH files), ``render_prometheus()`` returns
+   exposition text (scraped off the serving port, see
+   ``interop/serving.py``).
+
+Metric names are dotted (``engine.rows_processed_total``); Prometheus
+rendering prefixes ``tft_`` and maps dots to underscores
+(``tft_engine_rows_processed_total``).
+
+Kill switch: ``TFT_OBS=0`` in the environment (read once at import) or
+``Config(observability=False)`` disables all collection — increments,
+histogram observations, and span emission become no-ops.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.config import get_config, register_on_change
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_BUCKETS",
+    "enabled",
+    "registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "render_prometheus",
+]
+
+#: environment kill switch, read once — flipping the env var mid-process is
+#: not a supported path (use ``set_config(observability=...)`` for that)
+_ENV_OFF = os.environ.get("TFT_OBS", "1").strip().lower() in (
+    "0", "false", "off", "no",
+)
+
+#: the hot-path gate: a plain module global (one dict lookup to read),
+#: kept in sync with Config.observability by a set_config callback —
+#: deriving it per increment costs two extra function calls on every
+#: counter touch in the engine dispatch loop
+_ON = False
+
+
+def _refresh_enabled() -> None:
+    global _ON
+    _ON = (not _ENV_OFF) and get_config().observability
+
+
+register_on_change(_refresh_enabled)
+
+
+def enabled() -> bool:
+    """Whether collection is on (``TFT_OBS`` env AND the Config field)."""
+    return _ON
+
+
+#: default histogram bounds: log-scale, factor 4, 1 µs .. ~67 s — wide
+#: enough for both sub-ms device dispatches and multi-second cold compiles,
+#: fixed so series from different processes always merge bucket-for-bucket
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(1e-6 * 4.0 ** i for i in range(14))
+
+
+def _check_labels(
+    declared: Tuple[str, ...], got: Dict[str, Any], name: str
+) -> Tuple[str, ...]:
+    """Label dict -> series key, enforcing the declared label set (a typo'd
+    label name must fail loudly, not create a parallel series)."""
+    if len(got) != len(declared):
+        raise ValueError(
+            f"metric {name!r} declares labels {declared}; got "
+            f"{tuple(sorted(got))}"
+        )
+    try:
+        return tuple(str(got[k]) for k in declared)
+    except KeyError as e:
+        raise ValueError(
+            f"metric {name!r} declares labels {declared}; got "
+            f"{tuple(sorted(got))}"
+        ) from e
+
+
+class _Metric:
+    """Shared shell: name, help text, declared label names, series lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names: Tuple[str, ...] = tuple(labels)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
+        if not labels and not self.label_names:
+            return ()
+        return _check_labels(self.label_names, labels, self.name)
+
+
+class BoundCounter:
+    """A counter pre-bound to one label combination: the per-increment
+    label-dict validation and key construction are paid once at bind time,
+    which matters for fixed-label series on the engine dispatch path."""
+
+    __slots__ = ("_counter", "_key")
+
+    def __init__(self, counter: "Counter", key: Tuple[str, ...]):
+        self._counter = counter
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not _ON:
+            return
+        if amount < 0:
+            raise ValueError(
+                f"counter {self._counter.name!r} cannot decrease"
+            )
+        c = self._counter
+        with c._lock:
+            c._values[self._key] = c._values.get(self._key, 0.0) + amount
+
+
+class Counter(_Metric):
+    """Monotonic counter; ``inc(amount, **labels)``. Hot paths with a fixed
+    label combination should ``bind(**labels)`` once and increment the
+    bound handle."""
+
+    kind = "counter"
+
+    def __init__(self, name, help="", labels=()):
+        super().__init__(name, help, labels)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not _ON:
+            return
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def bind(self, **labels) -> BoundCounter:
+        return BoundCounter(self, self._key(labels))
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def _series(self):
+        with self._lock:
+            return dict(self._values)
+
+    def _reset(self):
+        with self._lock:
+            self._values.clear()
+
+
+class Gauge(_Metric):
+    """Point-in-time value; ``set``/``inc``/``dec``."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help="", labels=()):
+        super().__init__(name, help, labels)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        if not _ON:
+            return
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if not _ON:
+            return
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def adjust(self, amount: float, **labels) -> None:
+        """Unconditional add, bypassing the kill switch — for PAIRED
+        lifecycle updates (inc at start, dec in a finally) that must stay
+        balanced even when ``set_config(observability=...)`` flips mid
+        flight; a gated dec would otherwise no-op and leave the gauge
+        drifted forever. Callers gate the PAIR on one snapshot of
+        ``enabled()`` instead."""
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def _series(self):
+        with self._lock:
+            return dict(self._values)
+
+    def _reset(self):
+        with self._lock:
+            self._values.clear()
+
+
+class Histogram(_Metric):
+    """Fixed log-scale-bucket histogram; ``observe(value, **labels)``.
+
+    Bucket bounds are upper-inclusive (Prometheus ``le`` semantics): an
+    observation exactly on a bound lands in that bound's bucket. Values
+    above the last bound land in the implicit ``+Inf`` bucket.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labels=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labels)
+        bounds = tuple(float(b) for b in buckets)
+        if list(bounds) != sorted(bounds) or len(set(bounds)) != len(bounds):
+            raise ValueError(f"histogram {name!r} buckets must be increasing")
+        self.bounds: Tuple[float, ...] = bounds
+        #: key -> [per-bucket counts (+Inf last), sum, count]
+        self._values: Dict[Tuple[str, ...], List[Any]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        if not _ON:
+            return
+        key = self._key(labels)
+        # le-inclusive: bisect_left puts v == bound into bound's bucket
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            series = self._values.get(key)
+            if series is None:
+                series = self._values[key] = [
+                    [0] * (len(self.bounds) + 1), 0.0, 0,
+                ]
+            series[0][idx] += 1
+            series[1] += value
+            series[2] += 1
+
+    def series(self, **labels) -> Optional[Dict[str, Any]]:
+        """One series as ``{"counts": [...], "sum": s, "count": n}`` —
+        counts are per-bucket (NON-cumulative), ``+Inf`` last."""
+        s = self._values.get(self._key(labels))
+        if s is None:
+            return None
+        with self._lock:
+            return {"counts": list(s[0]), "sum": s[1], "count": s[2]}
+
+    def _series(self):
+        with self._lock:
+            return {
+                k: {"counts": list(v[0]), "sum": v[1], "count": v[2]}
+                for k, v in self._values.items()
+            }
+
+    def _reset(self):
+        with self._lock:
+            self._values.clear()
+
+
+def _label_str(names: Tuple[str, ...], key: Tuple[str, ...]) -> str:
+    return ",".join(f"{n}={v}" for n, v in zip(names, key))
+
+
+def _prom_name(name: str) -> str:
+    return "tft_" + name.replace(".", "_").replace("-", "_")
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(names: Tuple[str, ...], key: Tuple[str, ...], extra="") -> str:
+    parts = [f'{n}="{_prom_escape(v)}"' for n, v in zip(names, key)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class MetricsRegistry:
+    """Get-or-create metric registry. Creation is idempotent: asking for an
+    existing name returns the existing metric (type and label mismatches
+    raise — two modules silently disagreeing about a metric is a bug)."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, help, labels, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.label_names != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind}{m.label_names}; requested "
+                        f"{cls.kind}{tuple(labels)}"
+                    )
+                return m
+            m = cls(name, help, labels, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labels=()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name, help="", labels=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(
+        self, name, help="", labels=(), buckets=DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labels, buckets=buckets
+        )
+
+    def get(self, name: str) -> _Metric:
+        return self._metrics[name]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self) -> None:
+        """Zero every series (registrations survive) — test isolation."""
+        for m in list(self._metrics.values()):
+            m._reset()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain (JSON-serializable) dict of everything collected."""
+        out: Dict[str, Any] = {}
+        for name in self.names():
+            m = self._metrics[name]
+            out[name] = {
+                "type": m.kind,
+                "help": m.help,
+                "labels": list(m.label_names),
+                "values": {
+                    _label_str(m.label_names, k): v
+                    for k, v in m._series().items()
+                },
+            }
+            if isinstance(m, Histogram):
+                out[name]["buckets"] = list(m.bounds)
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus exposition text (format 0.0.4)."""
+        lines: List[str] = []
+        for name in self.names():
+            m = self._metrics[name]
+            pname = _prom_name(name)
+            if m.help:
+                lines.append(f"# HELP {pname} {m.help}")
+            lines.append(f"# TYPE {pname} {m.kind}")
+            series = m._series()
+            if isinstance(m, Histogram):
+                for key, s in sorted(series.items()):
+                    cum = 0
+                    for bound, cnt in zip(m.bounds, s["counts"]):
+                        cum += cnt
+                        lab = _prom_labels(
+                            m.label_names, key, extra=f'le="{bound!r}"'
+                        )
+                        lines.append(f"{pname}_bucket{lab} {cum}")
+                    cum += s["counts"][-1]
+                    lab = _prom_labels(m.label_names, key, extra='le="+Inf"')
+                    lines.append(f"{pname}_bucket{lab} {cum}")
+                    lab = _prom_labels(m.label_names, key)
+                    lines.append(f"{pname}_sum{lab} {_fmt(s['sum'])}")
+                    lines.append(f"{pname}_count{lab} {s['count']}")
+            else:
+                for key, v in sorted(series.items()):
+                    lab = _prom_labels(m.label_names, key)
+                    lines.append(f"{pname}{lab} {_fmt(v)}")
+        return "\n".join(lines) + "\n"
+
+
+_default = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default registry (what the serving scrape exports)."""
+    return _default
+
+
+def counter(name, help="", labels=()) -> Counter:
+    return _default.counter(name, help, labels)
+
+
+def gauge(name, help="", labels=()) -> Gauge:
+    return _default.gauge(name, help, labels)
+
+
+def histogram(name, help="", labels=(), buckets=DEFAULT_BUCKETS) -> Histogram:
+    return _default.histogram(name, help, labels, buckets)
+
+
+def snapshot() -> Dict[str, Any]:
+    return _default.snapshot()
+
+
+def render_prometheus() -> str:
+    return _default.render_prometheus()

@@ -1,0 +1,262 @@
+"""Span tracing: nested wall-clock spans, JSONL event log, Perfetto hookup.
+
+``span(name, **attrs)`` is the one primitive. It nests via a thread-local
+stack (each serving connection / decode worker gets its own tree), records
+wall duration and — when a pytree is attached via the ``sync`` argument or
+``Span.sync`` — a device-synchronized duration as well, and forwards to
+``jax.profiler.TraceAnnotation`` so spans appear as named slices inside
+Perfetto/TensorBoard traces captured by ``utils.profiling.trace()``.
+
+Completed spans are appended to a JSONL sink (one JSON object per line)
+configured with :func:`set_trace_sink` or the ``TFT_TRACE_FILE``
+environment variable. Event schema (stable; documented in
+``docs/observability.md``)::
+
+    {"name": str, "span_id": int, "parent_id": int | null, "depth": int,
+     "ts": float epoch-seconds at entry, "dur_s": float wall,
+     "dur_synced_s": float (only when a sync tree was attached),
+     "thread": str, "attrs": {str: json-value}}
+
+Events are written when a span CLOSES, so children appear before their
+parents — consumers reconstruct the tree from ``parent_id``.
+
+Everything honors the observability kill switch (``TFT_OBS=0`` /
+``Config(observability=False)``): a disabled ``span()`` yields ``None``
+and touches nothing.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.logging import get_logger
+from .metrics import enabled
+
+__all__ = [
+    "Span",
+    "span",
+    "current_span",
+    "set_trace_sink",
+    "trace_sink",
+    "set_annotations",
+]
+
+logger = get_logger("obs.tracing")
+
+_tls = threading.local()
+_ids = itertools.count(1)
+
+_sink_lock = threading.Lock()
+_sink = None
+_sink_owned = False  # we opened it (path arg) and must close it
+
+
+class Span:
+    """One live span (its own context manager — the generator-based
+    ``contextlib`` route costs ~2 µs per use, real money at engine-dispatch
+    frequency). Mutate ``attrs`` (or assign ``sync``) inside the ``with``
+    block to enrich the event before it is emitted."""
+
+    __slots__ = (
+        "name", "span_id", "parent_id", "depth", "attrs", "sync", "ts",
+        "_t0", "_ann",
+    )
+
+    def __init__(self, name, sync, attrs):
+        self.name = name
+        self.span_id = next(_ids)
+        self.parent_id = None
+        self.depth = 0
+        self.attrs: Dict[str, Any] = attrs
+        self.sync = sync
+        self.ts = 0.0
+        self._t0 = 0.0
+        self._ann = None
+
+    def __enter__(self) -> "Span":
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        if stack:
+            parent = stack[-1]
+            self.parent_id = parent.span_id
+            self.depth = len(stack)
+        stack.append(self)
+        if _annotations_on:
+            ann_cls = _annotation_cls()
+            if ann_cls is not None:
+                self._ann = ann_cls(self.name)
+                self._ann.__enter__()
+        self.ts = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        wall = time.perf_counter() - self._t0
+        synced = None
+        if self.sync is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(self.sync)
+                synced = time.perf_counter() - self._t0
+            except Exception:
+                pass  # sync is best-effort diagnostics, never a failure
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        _emit(self, wall, synced)
+        return False
+
+
+def current_span() -> Optional[Span]:
+    """The innermost open span on this thread, or ``None``."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def set_trace_sink(sink) -> None:
+    """Route span events: a path (opened append, line-buffered), a
+    file-like object (used as-is, not closed), or ``None`` to disable.
+    Replacing a path-opened sink closes it."""
+    global _sink, _sink_owned
+    with _sink_lock:
+        if _sink_owned and _sink is not None:
+            try:
+                _sink.close()
+            except OSError:
+                pass
+        if sink is None:
+            _sink, _sink_owned = None, False
+        elif isinstance(sink, (str, os.PathLike)):
+            _sink, _sink_owned = open(sink, "a", buffering=1), True
+        else:
+            _sink, _sink_owned = sink, False
+
+
+def trace_sink():
+    """The active sink file object (``None`` when disabled)."""
+    return _sink
+
+
+def _emit(s: Span, wall: float, synced: Optional[float]) -> None:
+    if _sink is None:
+        return
+    event = {
+        "name": s.name,
+        "span_id": s.span_id,
+        "parent_id": s.parent_id,
+        "depth": s.depth,
+        "ts": s.ts,
+        "dur_s": wall,
+        "thread": threading.current_thread().name,
+        "attrs": s.attrs,
+    }
+    if synced is not None:
+        event["dur_synced_s"] = synced
+    try:
+        line = json.dumps(event, default=str) + "\n"
+    except (TypeError, ValueError):  # pathological attrs must not raise
+        event["attrs"] = {k: str(v) for k, v in s.attrs.items()}
+        line = json.dumps(event, default=str) + "\n"
+    with _sink_lock:
+        sink = _sink
+        if sink is None:
+            return
+        try:
+            sink.write(line)
+        except (OSError, ValueError):
+            logger.warning("span sink write failed; disabling sink")
+            globals()["_sink"] = None
+            globals()["_sink_owned"] = False
+
+
+_ann_cls = None
+_ann_tried = False
+#: forward spans to jax.profiler.TraceAnnotation only while someone is
+#: actually capturing a trace: an annotation inside a dispatching pass
+#: measures ~5-10 µs (TraceMe + pybind crossing on a cold cache), which is
+#: pure waste when no Perfetto session exists to receive it.
+#: ``utils.profiling.trace()`` flips this automatically; direct
+#: ``jax.profiler.start_trace`` users call :func:`set_annotations`.
+_annotations_on = False
+
+
+def set_annotations(on: bool) -> None:
+    """Enable/disable TraceAnnotation forwarding for spans (normally
+    managed by ``utils.profiling.trace()``)."""
+    global _annotations_on
+    _annotations_on = bool(on)
+
+
+def _annotation_cls():
+    """``jax.profiler.TraceAnnotation`` resolved once (or ``None`` when
+    jax/its profiler is unavailable — spans must work without it)."""
+    global _ann_cls, _ann_tried
+    if not _ann_tried:
+        _ann_tried = True
+        try:
+            import jax
+
+            _ann_cls = jax.profiler.TraceAnnotation
+        except Exception:
+            _ann_cls = None
+    return _ann_cls
+
+
+class _NullSpan:
+    """Context manager for the disabled state: ``as`` binds ``None``."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def span(name: str, sync=None, **attrs):
+    """Open a nested span::
+
+        with span("engine.map_blocks", partitions=4) as sp:
+            out = run()
+            sp.sync = out          # optional: device-synced duration
+            sp.attrs["rows"] = n   # optional: enrich the event
+
+    Binds the :class:`Span` (or ``None`` when observability is off).
+    ``sync`` / ``Span.sync`` holds a pytree passed to
+    ``jax.block_until_ready`` before the synced duration is taken — only
+    attach work the caller is about to materialize anyway; syncing a
+    deliberately device-resident result would serialize the pipeline.
+
+    Spans are event producers: with no JSONL sink configured and no
+    profiler trace listening, a span has no observable effect, so the
+    whole mechanism is skipped (engine dispatch loops then pay one
+    predicate per op instead of allocation + clock reads). Consumers
+    attach by setting a sink / opening ``utils.profiling.trace()``
+    BEFORE the work they want to see.
+    """
+    if not enabled() or (_sink is None and not _annotations_on):
+        return _NULL
+    return Span(name, sync, dict(attrs))
+
+
+if os.environ.get("TFT_TRACE_FILE"):
+    try:
+        set_trace_sink(os.environ["TFT_TRACE_FILE"])
+    except OSError:
+        logger.warning(
+            "TFT_TRACE_FILE=%r could not be opened; span sink disabled",
+            os.environ["TFT_TRACE_FILE"],
+        )

@@ -47,10 +47,26 @@ class Config:
     max_retries: int = 2
     #: base of the exponential retry backoff, seconds.
     retry_backoff_s: float = 0.5
+    #: master switch for the observability layer (``tensorframes_tpu.obs``):
+    #: False makes every counter increment, histogram observation, and
+    #: span a no-op. ``TFT_OBS=0`` in the environment forces the same off
+    #: state regardless of this field (read once at import).
+    observability: bool = True
 
 
 _lock = threading.Lock()
 _config = Config()
+
+#: callbacks run after every set_config — lets hot paths cache derived
+#: flags (e.g. the observability on/off gate) as plain module globals
+#: instead of re-deriving them per call
+_on_change: list = []
+
+
+def register_on_change(cb) -> None:
+    """Run ``cb()`` now and after every future :func:`set_config`."""
+    _on_change.append(cb)
+    cb()
 
 
 def get_config() -> Config:
@@ -61,6 +77,8 @@ def set_config(**kwargs) -> Config:
     global _config
     with _lock:
         _config = dataclasses.replace(_config, **kwargs)
+    for cb in _on_change:
+        cb()
     return _config
 
 

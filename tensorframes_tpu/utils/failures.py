@@ -35,9 +35,43 @@ from typing import Callable, TypeVar
 
 from .logging import get_logger
 
-__all__ = ["is_oom", "is_transient", "run_with_retries", "DeviceOOMError"]
+__all__ = [
+    "is_oom",
+    "is_transient",
+    "run_with_retries",
+    "record_oom_split",
+    "DeviceOOMError",
+]
 
 logger = get_logger("failures")
+
+from ..obs.metrics import counter as _counter  # noqa: E402
+
+#: one series per (op, failure reason): makes flaky-link behavior (the
+#: degraded-link rows in BENCH_ALL_r05.json) graphable instead of a stream
+#: of warnings
+_retries_total = _counter(
+    "failures.retries_total",
+    "Transient device-runtime failures retried, by op and reason",
+    labels=("op", "reason"),
+)
+_retries_exhausted_total = _counter(
+    "failures.retries_exhausted_total",
+    "Transient failures that ran out of retry attempts",
+    labels=("op",),
+)
+_oom_splits_total = _counter(
+    "failures.oom_splits_total",
+    "OOM-degrade work-unit splits (chunk halvings / cap lowerings), by op",
+    labels=("op",),
+)
+
+
+def record_oom_split(op: str) -> None:
+    """Count one OOM-degrade split. The splits themselves happen in the
+    engine (``map_rows`` chunk halving, raised-chunk lowering); the counter
+    lives here with the rest of the failure telemetry."""
+    _oom_splits_total.inc(op=op)
 
 T = TypeVar("T")
 
@@ -77,6 +111,25 @@ def is_transient(e: BaseException) -> bool:
     return any(m in s for m in _TRANSIENT_MARKERS)
 
 
+def _failure_reason(e: BaseException) -> str:
+    """Short label for a classified failure: the matched status marker
+    (normalized), or the exception type when no marker matched."""
+    s = str(e)
+    for m in _OOM_MARKERS:
+        if m in s:
+            return "OOM"
+    for m in _TRANSIENT_MARKERS:
+        if m in s:
+            return m.upper().replace(" ", "_")
+    return type(e).__name__
+
+
+def _op_label(what: str) -> str:
+    """Bounded op label from a human ``what`` string: ``"map_blocks
+    partition 3"`` must not mint one counter series per partition."""
+    return what.split(" ", 1)[0] if what else "unknown"
+
+
 def run_with_retries(fn: Callable[[], T], what: str = "device dispatch") -> T:
     """Run ``fn``, retrying transient runtime failures with exponential
     backoff per the config (``max_retries`` / ``retry_backoff_s``). Raises
@@ -91,9 +144,12 @@ def run_with_retries(fn: Callable[[], T], what: str = "device dispatch") -> T:
             return fn()
         except Exception as e:  # noqa: BLE001 — classified below
             if not is_transient(e) or attempt >= cfg.max_retries:
+                if is_transient(e):
+                    _retries_exhausted_total.inc(op=_op_label(what))
                 raise
             delay = cfg.retry_backoff_s * (2.0 ** attempt)
             attempt += 1
+            _retries_total.inc(op=_op_label(what), reason=_failure_reason(e))
             logger.warning(
                 "%s failed with a transient error (%s); retry %d/%d in %.1fs",
                 what,

@@ -29,17 +29,29 @@ def trace(log_dir: str, host_tracer_level: int = 2):
 
         with tft.utils.profiling.trace("/tmp/trace"):
             df2.collect()
+
+    While the capture is open, observability spans
+    (:func:`tensorframes_tpu.obs.span`) forward to
+    ``jax.profiler.TraceAnnotation`` and appear as named slices in the
+    resulting trace; outside a capture that forwarding is skipped (it
+    costs real microseconds per span with nobody listening). Direct
+    ``jax.profiler.start_trace`` users can opt in with
+    ``tft.obs.set_annotations(True)``.
     """
     import jax
+
+    from ..obs.tracing import set_annotations
 
     try:
         jax.profiler.start_trace(log_dir, host_tracer_level=host_tracer_level)
     except TypeError:
         # newer jax moved tracer options off the start_trace signature
         jax.profiler.start_trace(log_dir)
+    set_annotations(True)
     try:
         yield
     finally:
+        set_annotations(False)
         jax.profiler.stop_trace()
 
 
@@ -50,11 +62,20 @@ class Timer:
     >>> with t.section("score"):
     ...     out = engine_call()
     >>> t.report()
+
+    ``publish=True`` additionally streams every section duration into the
+    observability registry (``profiling.timer_seconds{section=...}``
+    histogram, :mod:`tensorframes_tpu.obs`), so ad-hoc Timer numbers show
+    up on the same scrape as the engine/serving metrics. The default
+    stays registry-free — existing callers are unaffected.
     """
 
-    def __init__(self):
+    def __init__(self, publish: bool = False):
         self.totals: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
+        self.mins: Dict[str, float] = {}
+        self.maxs: Dict[str, float] = {}
+        self._publish = publish
 
     @contextlib.contextmanager
     def section(self, name: str, sync=None):
@@ -67,6 +88,26 @@ class Timer:
             dt = time.perf_counter() - t0
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
+            if name not in self.mins or dt < self.mins[name]:
+                self.mins[name] = dt
+            if name not in self.maxs or dt > self.maxs[name]:
+                self.maxs[name] = dt
+            if self._publish:
+                _timer_seconds().observe(dt, section=name)
+
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        """Per-section stats as a plain (JSON-able) dict:
+        ``{section: {"total_s", "count", "min_s", "max_s", "mean_s"}}``."""
+        return {
+            name: {
+                "total_s": self.totals[name],
+                "count": self.counts[name],
+                "min_s": self.mins[name],
+                "max_s": self.maxs[name],
+                "mean_s": self.totals[name] / self.counts[name],
+            }
+            for name in self.totals
+        }
 
     def report(self) -> str:
         lines = []
@@ -78,3 +119,21 @@ class Timer:
                 f"{tot / n * 1e3:.3f} ms/call"
             )
         return "\n".join(lines)
+
+
+def _timer_seconds():
+    """The shared ``Timer`` histogram (created on first publishing Timer —
+    importing this module must not touch the registry)."""
+    global _timer_hist
+    if _timer_hist is None:
+        from ..obs.metrics import histogram
+
+        _timer_hist = histogram(
+            "profiling.timer_seconds",
+            "Timer section durations (seconds), by section",
+            labels=("section",),
+        )
+    return _timer_hist
+
+
+_timer_hist = None
