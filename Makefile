@@ -6,13 +6,19 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-fast test-multihost verify bench bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-multihost verify bench bench-serve bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
 
-# full suite on a virtual 8-device CPU mesh (conftest forces the backend)
+# full suite on a virtual 8-device CPU mesh (conftest forces the backend).
+# NO -x: merge CI must report EVERY failure, not stop at the first and
+# hide the rest (use test-failfast for the edit loop)
 test:
+	$(PY) -m pytest tests/ -q
+
+# stop at the first failure — the local edit-debug convenience
+test-failfast:
 	$(PY) -m pytest tests/ -x -q
 
 # the edit-test loop tier: everything not marked slow, parallelized;
@@ -35,6 +41,10 @@ test-multihost:
 # headline metric (one JSON line; targets the attached TPU)
 bench:
 	$(PY) bench.py
+
+# serving trajectory: tokens/s + inter-token latency at 1/4/16 concurrency
+bench-serve:
+	$(PY) bench.py decode_serve
 
 # all BASELINE configs + extras
 bench-all:

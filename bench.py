@@ -26,6 +26,12 @@ comparison point is the same scoring computed by numpy on the host CPU of
 this machine — a stand-in for the reference's CPU execution path.
 
 Prints exactly one JSON line.
+
+``python bench.py decode_serve`` instead benchmarks the continuous-
+batching generation engine (``tensorframes_tpu/serve``): tokens/sec and
+p50/p99 INTER-TOKEN latency at 1, 4 and 16 concurrent requests — the
+serving trajectory the ROADMAP's heavy-traffic target is measured by.
+Also exactly one JSON line.
 """
 
 import json
@@ -265,5 +271,109 @@ def main():
     )
 
 
+def _serve_one_concurrency(lm, n_requests, plen, max_new, seed):
+    """One timed serving run: ``n_requests`` streams decoded through one
+    shared continuous batch. Token timestamps are taken on the consumer
+    side (per-stream iterators on their own threads), so the measured
+    inter-token gaps include the full engine path — scheduling, the
+    compiled step, host sync, and handle delivery."""
+    import threading
+
+    from tensorframes_tpu.serve import GenerationEngine
+
+    rng = np.random.default_rng(seed)
+    prompts = [
+        rng.integers(1, 256, size=plen).astype(np.int32).tolist()
+        for _ in range(n_requests)
+    ]
+    eng = GenerationEngine(
+        lm,
+        max_slots=n_requests,
+        page_size=16,
+        max_seq_len=plen + max_new,
+        queue_capacity=n_requests,
+    )
+    # warmup: compile prefill + decode outside the timed window
+    eng.generate([prompts[0]], 2)
+    stamps = [[] for _ in range(n_requests)]
+
+    def consume(i, handle):
+        for _ in handle:
+            stamps[i].append(time.perf_counter())
+
+    with eng:
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new) for p in prompts]
+        threads = [
+            threading.Thread(target=consume, args=(i, h))
+            for i, h in enumerate(handles)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+    total = n_requests * max_new
+    gaps = sorted(
+        b - a for s in stamps for a, b in zip(s, s[1:])
+    )
+    ttfts = [s[0] - t0 for s in stamps if s]
+
+    def pct(xs, p):
+        return xs[min(len(xs) - 1, int(p * (len(xs) - 1)))] if xs else None
+
+    return {
+        "tokens_per_sec": round(total / dt, 1),
+        "itl_p50_ms": round(pct(gaps, 0.50) * 1e3, 3),
+        "itl_p99_ms": round(pct(gaps, 0.99) * 1e3, 3),
+        "ttft_max_ms": round(max(ttfts) * 1e3, 3),
+        "wall_s": round(dt, 3),
+        "compiled_step_programs": eng.num_step_programs,
+    }
+
+
+def main_decode_serve():
+    import jax
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.models import TransformerLM
+
+    tft.enable_compilation_cache()
+    lm = TransformerLM.init(
+        0, 256, d_model=128, n_heads=8, n_layers=4, max_len=256
+    )
+    plen, max_new = 32, 64
+    levels = {}
+    for c in (1, 4, 16):
+        levels[str(c)] = _serve_one_concurrency(
+            lm, c, plen=plen, max_new=max_new, seed=c
+        )
+    head = levels["16"]
+    print(
+        json.dumps(
+            {
+                "metric": "decode_serve_tokens_per_sec",
+                "value": head["tokens_per_sec"],
+                "unit": "tok/s",
+                "detail": {
+                    "workload": (
+                        f"continuous-batching greedy decode, prompt {plen} "
+                        f"+ {max_new} new tokens per request, paged KV "
+                        f"(page_size 16)"
+                    ),
+                    "model": "d128 h8 L4 vocab256",
+                    "device": str(jax.devices()[0]),
+                    "concurrency": levels,
+                },
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "decode_serve":
+        main_decode_serve()
+    else:
+        main()

@@ -31,14 +31,23 @@ everything before reading anything. ``streaming=True`` still bounds the
 server's FRAME memory by running row-local programs per incoming batch.
 
 Observability: the same port doubles as a Prometheus scrape target. A
-connection whose first bytes are ``GET `` is answered as a plain HTTP
-request — ``GET /metrics`` returns the process-wide registry in
-exposition format (an Arrow IPC stream can never start with ``GET ``,
-so the two protocols cannot be confused). Each scoring connection
+connection whose first bytes are ``GET `` or ``POST`` is answered as a
+plain HTTP request — ``GET /metrics`` returns the process-wide registry
+in exposition format (an Arrow IPC stream can never start with those
+bytes, so the two protocols cannot be confused). Each scoring connection
 increments ``serving.requests_total{kind,status}``, the byte counters,
 and the ``serving.request_seconds`` latency histogram; concurrent load
 shows up on the ``serving.active_connections`` gauge. See
 ``docs/observability.md``.
+
+Generation: constructed with ``engine=`` (a
+:class:`~tensorframes_tpu.serve.GenerationEngine`), the same port also
+serves ``POST /generate`` — JSON in (``{"prompt": [ids],
+"max_new_tokens": n, "temperature"?, "top_p"?, "seed"?}``), JSON out
+(``{"request_id": ..., "tokens": [ids]}``) — backed by the engine's
+continuous-batching loop, so concurrent connections share one decode
+batch and one page pool (see ``docs/serving_llm.md``). A full admission
+queue answers 503 (backpressure), an infeasible request 400.
 """
 
 from __future__ import annotations
@@ -61,7 +70,8 @@ __all__ = ["ScoringServer", "remote_arrow_mapper", "remote_map_in_arrow"]
 
 _m_requests = _counter(
     "serving.requests_total",
-    "Connections served, by kind (score|metrics) and terminal status",
+    "Connections served, by kind (score|metrics|generate) and terminal "
+    "status",
     labels=("kind", "status"),
 )
 _m_bytes_in = _counter(
@@ -121,7 +131,7 @@ class ScoringServer:
 
     def __init__(
         self,
-        fetches,
+        fetches=None,
         *,
         trim: bool = False,
         feed_dict: Optional[Dict[str, str]] = None,
@@ -132,20 +142,33 @@ class ScoringServer:
         host: str = "127.0.0.1",
         port: int = 0,
         max_connections: int = 8,
+        engine=None,
     ):
-        from .spark import arrow_batch_mapper
+        if fetches is None and engine is None:
+            raise ValueError(
+                "ScoringServer needs a scoring program (fetches) and/or a "
+                "generation engine (engine=)"
+            )
+        if fetches is not None:
+            from .spark import arrow_batch_mapper
 
-        #: the same executor-side mapper the in-Spark path uses — the
-        #: server is "an executor that happens to own the chip"
-        self._mapper = arrow_batch_mapper(
-            fetches,
-            trim=trim,
-            feed_dict=feed_dict,
-            decoders=decoders,
-            constants=constants,
-            batch_rows=batch_rows,
-            streaming=streaming,
-        )
+            #: the same executor-side mapper the in-Spark path uses — the
+            #: server is "an executor that happens to own the chip"
+            self._mapper = arrow_batch_mapper(
+                fetches,
+                trim=trim,
+                feed_dict=feed_dict,
+                decoders=decoders,
+                constants=constants,
+                batch_rows=batch_rows,
+                streaming=streaming,
+            )
+        else:
+            self._mapper = None
+        #: optional continuous-batching generation engine backing
+        #: ``POST /generate`` (tensorframes_tpu.serve.GenerationEngine)
+        self._engine = engine
+        self._engine_started_here = False
         self._host = host
         self._requested_port = port  # 0 = ephemeral, fresh per start()
         self._port = port
@@ -171,6 +194,12 @@ class ScoringServer:
         s.bind((self._host, self._requested_port))
         s.listen()
         self._sock = s
+        if self._engine is not None and self._engine._thread is None:
+            # the generate endpoint needs the stepping loop; start it for
+            # the server's lifetime (an engine the caller already started
+            # is left under the caller's control)
+            self._engine.start()
+            self._engine_started_here = True
         self._port = s.getsockname()[1]
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
@@ -186,6 +215,9 @@ class ScoringServer:
 
     def stop(self) -> None:
         self._stopping.set()
+        if self._engine_started_here:
+            self._engine.stop()
+            self._engine_started_here = False
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -224,22 +256,31 @@ class ScoringServer:
                 target=self._serve_one, args=(conn,), daemon=True
             ).start()
 
-    @staticmethod
-    def _peek(conn: socket.socket) -> bytes:
+    #: HTTP verbs the Arrow port answers as plain HTTP (an Arrow IPC
+    #: stream can never start with these bytes)
+    _HTTP_PREFIXES = (b"GET ", b"POST")
+
+    @classmethod
+    def _peek(cls, conn: socket.socket) -> bytes:
         """The request's first bytes without consuming them (so the Arrow
         reader still sees a whole stream). Blocks for the FIRST byte just
         like the pre-scrape server blocked in the Arrow parser — a slow
         client must not be dropped. Waits for more bytes ONLY while the
-        prefix is still ambiguous with ``b"GET "`` (an Arrow stream's
-        first byte is never ``G``, so Arrow clients route immediately);
-        that disambiguation wait is bounded so a client wedged exactly at
-        ``b"GE"`` falls through to the Arrow path — the same failure
-        surface it would have hit before the scrape existed."""
+        prefix is still ambiguous with an HTTP verb (an Arrow stream's
+        first byte is never ``G`` or ``P``, so Arrow clients route
+        immediately); that disambiguation wait is bounded so a client
+        wedged exactly at ``b"GE"`` falls through to the Arrow path — the
+        same failure surface it would have hit before the scrape
+        existed."""
         buf = conn.recv(4, socket.MSG_PEEK)  # blocking first-byte wait
-        if not buf or not b"GET ".startswith(buf[:4]):
+        if not buf or not any(
+            v.startswith(buf[:4]) for v in cls._HTTP_PREFIXES
+        ):
             return buf
         deadline = time.monotonic() + 10.0
-        while len(buf) < 4 and b"GET ".startswith(buf):
+        while len(buf) < 4 and any(
+            v.startswith(buf) for v in cls._HTTP_PREFIXES
+        ):
             if time.monotonic() > deadline:
                 break
             time.sleep(0.005)
@@ -248,38 +289,129 @@ class ScoringServer:
                 break
         return buf
 
-    def _serve_metrics(self, conn: socket.socket) -> None:
-        """Answer a plain-HTTP request on the Arrow port: ``GET /metrics``
-        returns the default registry in Prometheus exposition format, so
-        ``curl http://host:port/metrics`` (or an actual Prometheus scrape
-        job) works against a live scoring server with no sidecar."""
+    def _serve_http(self, conn: socket.socket) -> str:
+        """Answer a plain-HTTP request on the Arrow port. Routes:
+
+        - ``GET /metrics`` — the default registry in Prometheus
+          exposition format, so ``curl http://host:port/metrics`` (or an
+          actual scrape job) works against a live server with no sidecar;
+        - ``POST /generate`` (``engine=`` configured) — JSON
+          ``{"prompt": [ids], "max_new_tokens": n, "temperature"?,
+          "top_p"?, "seed"?}`` submitted to the continuous-batching
+          engine; responds ``{"request_id", "tokens"}`` when the stream
+          completes. 503 on a full admission queue (backpressure), 400 on
+          an infeasible request.
+
+        Returns the request kind for the metrics label."""
+        import json
+
         conn.settimeout(10)
-        head = b""
-        while b"\r\n\r\n" not in head and len(head) < 8192:
+        buf = b""
+        while b"\r\n\r\n" not in buf and len(buf) < 65536:
             chunk = conn.recv(4096)
             if not chunk:
                 break
-            head += chunk
+            buf += chunk
+        head, _, body = buf.partition(b"\r\n\r\n")
         line = head.split(b"\r\n", 1)[0].decode("latin-1", "replace")
         parts = line.split()
-        path = parts[1] if len(parts) > 1 else "/"
-        if path.split("?", 1)[0] in ("/metrics", "/metrics/"):
-            body = _render_prometheus().encode("utf-8")
+        verb = parts[0].upper() if parts else ""
+        path = (parts[1] if len(parts) > 1 else "/").split("?", 1)[0]
+        clen = 0
+        for hline in head.split(b"\r\n")[1:]:
+            name, _, val = hline.partition(b":")
+            if name.strip().lower() == b"content-length":
+                try:
+                    clen = int(val.strip())
+                except ValueError:
+                    pass
+        while len(body) < clen:
+            chunk = conn.recv(4096)
+            if not chunk:
+                break
+            body += chunk
+
+        kind = "metrics"
+        ctype = "text/plain; charset=utf-8"
+        if verb == "GET" and path in ("/metrics", "/metrics/"):
+            out = _render_prometheus().encode("utf-8")
             status = "200 OK"
             ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif verb == "POST" and path == "/generate":
+            kind = "generate"
+            status, out = self._handle_generate(body)
+            ctype = "application/json; charset=utf-8"
         else:
-            body = b"scrape endpoint: GET /metrics\n"
+            out = b"endpoints: GET /metrics, POST /generate\n"
             status = "404 Not Found"
-            ctype = "text/plain; charset=utf-8"
         conn.sendall(
             (
                 f"HTTP/1.1 {status}\r\n"
                 f"Content-Type: {ctype}\r\n"
-                f"Content-Length: {len(body)}\r\n"
+                f"Content-Length: {len(out)}\r\n"
                 "Connection: close\r\n\r\n"
             ).encode("latin-1")
-            + body
+            + out
         )
+        return kind
+
+    def _handle_generate(self, body: bytes) -> Tuple[str, bytes]:
+        """One generate request against the engine; returns (status,
+        JSON body). Failure modes map to HTTP semantics instead of
+        crashing the connection thread: bad JSON / infeasible request →
+        400, no engine → 501, full admission queue → 503."""
+        import json
+
+        if self._engine is None:
+            return "501 Not Implemented", json.dumps(
+                {"error": "server has no generation engine"}
+            ).encode("utf-8")
+        from ..serve.scheduler import QueueFullError
+
+        try:
+            spec = json.loads(body.decode("utf-8") or "{}")
+            prompt = spec["prompt"]
+            max_new = int(spec["max_new_tokens"])
+        except (ValueError, KeyError, TypeError) as e:
+            return "400 Bad Request", json.dumps(
+                {"error": f"bad request: {type(e).__name__}: {e}"}
+            ).encode("utf-8")
+        try:
+            handle = self._engine.submit(
+                prompt,
+                max_new,
+                temperature=float(spec.get("temperature", 0.0)),
+                top_p=float(spec.get("top_p", 1.0)),
+                seed=int(spec.get("seed", 0)),
+                block=False,
+            )
+        except QueueFullError as e:
+            return "503 Service Unavailable", json.dumps(
+                {"error": str(e)}
+            ).encode("utf-8")
+        except ValueError as e:
+            return "400 Bad Request", json.dumps(
+                {"error": str(e)}
+            ).encode("utf-8")
+        try:
+            toks = handle.result(timeout=300)
+        except TimeoutError as e:
+            return "504 Gateway Timeout", json.dumps(
+                {"request_id": handle.request_id, "error": str(e)}
+            ).encode("utf-8")
+        except Exception as e:  # engine-side failure closed the handle
+            return "500 Internal Server Error", json.dumps(
+                {
+                    "request_id": handle.request_id,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            ).encode("utf-8")
+        return "200 OK", json.dumps(
+            {
+                "request_id": handle.request_id,
+                "tokens": [int(t) for t in toks],
+            }
+        ).encode("utf-8")
 
     def _serve_one(self, conn: socket.socket) -> None:
         import pyarrow as pa
@@ -300,15 +432,20 @@ class ScoringServer:
                     # client connected and went away without a request
                     status = "empty"
                     return
-                if first == b"GET ":
-                    kind = "metrics"
+                if first in self._HTTP_PREFIXES:
+                    kind = "http"
                     try:
-                        self._serve_metrics(conn)
+                        kind = self._serve_http(conn)
                     except OSError:
                         status = "error"
                     return
                 wf = None
                 try:
+                    if self._mapper is None:
+                        raise RuntimeError(
+                            "server has no scoring program (generate-only "
+                            "server; use POST /generate)"
+                        )
                     rf = _CountingFile(conn.makefile("rb"), _m_bytes_in)
                     reader = pa.ipc.open_stream(rf)
                     # results buffer until the request stream ends: a

@@ -18,6 +18,8 @@ from .transformer import (
     transformer_generate,
     transformer_logits,
     transformer_loss,
+    transformer_prefill,
+    transformer_step,
 )
 
 __all__ = [
@@ -30,6 +32,8 @@ __all__ = [
     "transformer_generate",
     "transformer_logits",
     "transformer_loss",
+    "transformer_prefill",
+    "transformer_step",
     "filter_logits",
     "left_pad_prompts",
     "MLPClassifier",

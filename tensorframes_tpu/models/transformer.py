@@ -24,6 +24,8 @@ __all__ = [
     "init_transformer",
     "transformer_logits",
     "transformer_generate",
+    "transformer_step",
+    "transformer_prefill",
     "transformer_loss",
     "token_nll",
     "TransformerLM",
@@ -356,6 +358,117 @@ def left_pad_prompts(seqs, pad_id: int = 0):
     return out, lengths
 
 
+def transformer_step(params, tok, positions, attend, moe_top_k: int = 1):
+    """One decoder step for a batch of single tokens — THE per-token block
+    walk, shared by the scan decode (:func:`transformer_generate`) and the
+    paged serving engine (:mod:`tensorframes_tpu.serve`) so the two decode
+    paths cannot drift apart.
+
+    ``tok`` [B] int32 current tokens; ``positions`` [B] int32 positional
+    indices (already offset/clipped by the caller). Attention is delegated
+    to ``attend(li, q, k, v) -> [B, d_model]``: the callback owns the KV
+    state — it receives layer ``li``'s query ``[B, n_kv, group, hd]``
+    (grouped-query layout; ``group == 1`` rows share a k/v head) and this
+    step's k/v ``[B, n_kv, hd]``, stores k/v wherever the caller keeps its
+    cache (scan-carried dense cache, paged pool), reads the visible
+    history, and returns the pre-``proj`` attention context. Returns
+    logits ``[B, vocab]``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.moe import moe_ffn
+
+    embed = jnp.asarray(params["embed"])
+    posemb = jnp.asarray(params["pos"])
+    n_heads = params["n_heads"]
+    d_model = embed.shape[1]
+    hd = d_model // n_heads
+    bsz = tok.shape[0]
+    h = embed[tok] + posemb[positions]
+    for li, block in enumerate(params["blocks"]):
+        n_kv = _kv_heads(block, d_model, n_heads)
+        group = n_heads // n_kv
+        kv_d = n_kv * hd
+        x = _ln(h, block["ln1"])
+        qkv = x @ jnp.asarray(block["qkv"])
+        q, k, v = jnp.split(qkv, [d_model, d_model + kv_d], axis=-1)
+        att = attend(
+            li,
+            q.reshape(bsz, n_kv, group, hd),
+            k.reshape(bsz, n_kv, hd),
+            v.reshape(bsz, n_kv, hd),
+        )
+        h = h + att @ jnp.asarray(block["proj"])
+        hx = _ln(h, block["ln2"])
+        if "moe" in block:
+            h = h + moe_ffn(block["moe"], hx[:, None, :], k=moe_top_k)[
+                :, 0
+            ]
+        else:
+            h = h + jax.nn.gelu(hx @ jnp.asarray(block["up"])) @ (
+                jnp.asarray(block["down"])
+            )
+    return _ln(h, params["ln_f"]) @ embed.T
+
+
+def transformer_prefill(params, tokens, moe_top_k: int = 1):
+    """Batched causal prompt pass that also RETURNS the per-layer k/v in
+    the decode-cache layout: ``tokens`` [B, P] ->
+    ``(logits [B, P, vocab], k [L, B, n_kv, P, hd], v [L, B, n_kv, P, hd])``.
+
+    This is the prefill half of serving decode: the whole prompt runs as
+    dense MXU matmuls in one pass (instead of P sequential cache steps),
+    and the caller scatters the returned k/v into its cache/page pool and
+    continues with :func:`transformer_step`. Attention uses the same
+    grouped-query einsum family as the step path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.moe import moe_ffn
+
+    tokens = jnp.asarray(tokens, dtype=jnp.int32)
+    bsz, plen = tokens.shape
+    n_heads = params["n_heads"]
+    embed = jnp.asarray(params["embed"])
+    posemb = jnp.asarray(params["pos"])
+    d_model = embed.shape[1]
+    hd = d_model // n_heads
+    scale = 1.0 / float(np.sqrt(hd))
+    neg = jnp.finfo(jnp.float32).min * 0.7
+    causal = (
+        jnp.arange(plen)[:, None] >= jnp.arange(plen)[None, :]
+    )  # [P(q), P(k)]
+    h = embed[tokens] + posemb[:plen][None]
+    ks, vs = [], []
+    for block in params["blocks"]:
+        n_kv = _kv_heads(block, d_model, n_heads)
+        group = n_heads // n_kv
+        kv_d = n_kv * hd
+        x = _ln(h, block["ln1"])
+        qkv = x @ jnp.asarray(block["qkv"])
+        q, k, v = jnp.split(qkv, [d_model, d_model + kv_d], axis=-1)
+        # cache layout [B, n_kv, P, hd] — what the decode step reads
+        kc = k.reshape(bsz, plen, n_kv, hd).transpose(0, 2, 1, 3)
+        vc = v.reshape(bsz, plen, n_kv, hd).transpose(0, 2, 1, 3)
+        ks.append(kc)
+        vs.append(vc)
+        qh = q.reshape(bsz, plen, n_kv, group, hd).transpose(0, 2, 3, 1, 4)
+        s = jnp.einsum("bkgqd,bktd->bkgqt", qh, kc) * scale
+        s = jnp.where(causal[None, None, None], s, neg)
+        att = jnp.einsum("bkgqt,bktd->bkgqd", jax.nn.softmax(s, axis=-1), vc)
+        att = att.transpose(0, 3, 1, 2, 4).reshape(bsz, plen, d_model)
+        h = h + att @ jnp.asarray(block["proj"])
+        hx = _ln(h, block["ln2"])
+        if "moe" in block:
+            h = h + moe_ffn(block["moe"], hx, k=moe_top_k)
+        else:
+            h = h + jax.nn.gelu(hx @ jnp.asarray(block["up"])) @ (
+                jnp.asarray(block["down"])
+            )
+    logits = _ln(h, params["ln_f"]) @ embed.T
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
 def transformer_generate(
     params: Params,
     prompt,
@@ -393,8 +506,6 @@ def transformer_generate(
     import jax
     import jax.numpy as jnp
 
-    from ..parallel.moe import moe_ffn
-
     prompt = jnp.asarray(prompt, dtype=jnp.int32)
     if prompt.ndim != 2 or prompt.shape[1] < 1:
         raise ValueError("prompt must be [B, P>=1] token ids")
@@ -428,8 +539,6 @@ def transformer_generate(
     # GQA: the cache stores only the model's n_kv k/v heads — the decode
     # memory ceiling shrinks by the group factor (n_kv == n_heads for MHA)
     n_kv = _kv_heads(blocks[0], d_model, n_heads)
-    group = n_heads // n_kv
-    kv_d = n_kv * hd
     k0 = jnp.zeros((len(blocks), bsz, n_kv, total, hd), jnp.float32)
     v0 = jnp.zeros_like(k0)
 
@@ -442,48 +551,38 @@ def transformer_generate(
             ),
             prev,
         )
-        # per-row position offset: a left-padded row's token at slot t sits
-        # at real position t - offset (pad slots gather position 0; they
-        # are masked out of attention below, so the value never matters)
-        h = embed[tok] + posemb[jnp.clip(t - offsets, 0, total - 1)]
         # visible = causal AND not a pad slot (slot j belongs to row b's
         # prompt iff j >= offsets[b])
         slots = jnp.arange(total)[None, :]
         visible = (slots <= t) & (slots >= offsets[:, None])  # [B, T]
-        for li, block in enumerate(blocks):
-            x = _ln(h, block["ln1"])
-            qkv = x @ jnp.asarray(block["qkv"])
-            q, k, v = jnp.split(qkv, [d_model, d_model + kv_d], axis=-1)
+        caches = [kc, vc]
+
+        def attend(li, q, k, v):
             # grouped-query layout: q [B, n_kv, g, hd] against a cache
             # holding only n_kv k/v heads (g = 1 and n_kv = n_heads for
-            # plain MHA — same math, same program shape)
-            q = q.reshape(bsz, n_kv, group, hd)
-            kc = jax.lax.dynamic_update_slice(
-                kc,
-                k.reshape(1, bsz, n_kv, 1, hd),
-                (li, 0, 0, t, 0),
+            # plain MHA — same math, same program shape). k/v land in the
+            # scan-carried static-shape cache at slot t; attention reads
+            # the whole cache under the visibility mask.
+            caches[0] = jax.lax.dynamic_update_slice(
+                caches[0], k.reshape(1, bsz, n_kv, 1, hd), (li, 0, 0, t, 0)
             )
-            vc = jax.lax.dynamic_update_slice(
-                vc,
-                v.reshape(1, bsz, n_kv, 1, hd),
-                (li, 0, 0, t, 0),
+            caches[1] = jax.lax.dynamic_update_slice(
+                caches[1], v.reshape(1, bsz, n_kv, 1, hd), (li, 0, 0, t, 0)
             )
-            s = jnp.einsum("bkgd,bktd->bkgt", q, kc[li]) * scale
+            s = jnp.einsum("bkgd,bktd->bkgt", q, caches[0][li]) * scale
             s = jnp.where(visible[:, None, None, :], s, neg)
-            att = jnp.einsum(
-                "bkgt,bktd->bkgd", jax.nn.softmax(s, axis=-1), vc[li]
+            return jnp.einsum(
+                "bkgt,bktd->bkgd", jax.nn.softmax(s, axis=-1), caches[1][li]
             ).reshape(bsz, d_model)
-            h = h + att @ jnp.asarray(block["proj"])
-            hx = _ln(h, block["ln2"])
-            if "moe" in block:
-                h = h + moe_ffn(block["moe"], hx[:, None, :], k=moe_top_k)[
-                    :, 0
-                ]
-            else:
-                h = h + jax.nn.gelu(hx @ jnp.asarray(block["up"])) @ (
-                    jnp.asarray(block["down"])
-                )
-        logits = _ln(h, params["ln_f"]) @ embed.T
+
+        # per-row position offset: a left-padded row's token at slot t sits
+        # at real position t - offset (pad slots gather position 0; they
+        # are masked out of attention above, so the value never matters)
+        logits = transformer_step(
+            params, tok, jnp.clip(t - offsets, 0, total - 1), attend,
+            moe_top_k=moe_top_k,
+        )
+        kc, vc = caches
         if sampled:
             key = jax.random.fold_in(jax.random.PRNGKey(seed), t)
             scaled = logits / jnp.maximum(

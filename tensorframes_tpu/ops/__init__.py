@@ -1,13 +1,19 @@
 """Hot-op kernels: Pallas flash attention + ring/Ulysses sequence
 parallelism."""
 
-from .attention import flash_attention, attention_reference, online_block_update
+from .attention import (
+    attention_reference,
+    flash_attention,
+    online_block_update,
+    paged_attention,
+)
 from .ring import ring_attention, ring_attention_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
     "flash_attention",
     "attention_reference",
+    "paged_attention",
     "online_block_update",
     "ring_attention",
     "ring_attention_sharded",

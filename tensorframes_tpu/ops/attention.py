@@ -28,6 +28,7 @@ import numpy as np
 __all__ = [
     "flash_attention",
     "attention_reference",
+    "paged_attention",
     "online_block_update",
     "flash_carry",
     "flash_bwd_pair",
@@ -196,6 +197,45 @@ def attention_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(
         q.dtype
     )
+
+
+def paged_attention(q, k_pages, v_pages, page_table, lengths):
+    """Single-token attention read over a PAGED KV cache — the decode-side
+    gather for the serving engine (:mod:`tensorframes_tpu.serve`), where
+    each sequence's keys/values live in fixed-size pages scattered through
+    a static pool instead of one contiguous cache row.
+
+    ``q`` [S, n_kv, group, hd] — one query token per slot, grouped-query
+    layout (``group = n_heads / n_kv``; 1-sized slot batches and MHA both
+    degenerate cleanly). ``k_pages``/``v_pages`` [pool_pages, page_size,
+    n_kv, hd] — the shared page pool. ``page_table`` [S, max_pages] int32
+    — each slot's ordered page list (entries past the sequence's live
+    pages may point anywhere valid; the position mask excludes them).
+    ``lengths`` [S] int32 — valid positions per slot, INCLUDING the token
+    just written.
+
+    Every shape is static: the gather reads ``max_pages * page_size``
+    positions per slot and masks ``t >= lengths`` to ``_NEG_BIG`` before
+    the softmax (masked lanes underflow to exactly 0), so one compiled
+    program serves every mix of sequence lengths and slot turnover — the
+    no-recompile property continuous batching depends on. The einsum
+    family matches the dense decode-cache read in
+    ``models.transformer.transformer_generate`` (same contraction axes,
+    same mask value), so paged and dense decode agree to float
+    associativity. Returns [S, n_kv, group, hd]."""
+    slots, n_kv, group, hd = q.shape
+    mp = page_table.shape[1]
+    ps = k_pages.shape[1]
+    t = mp * ps
+    # [S, max_pages, ps, n_kv, hd] -> [S, T, n_kv, hd]: pages in table
+    # order ARE position order (page i holds positions i*ps..(i+1)*ps-1)
+    kg = k_pages[page_table].reshape(slots, t, n_kv, hd)
+    vg = v_pages[page_table].reshape(slots, t, n_kv, hd)
+    scale = 1.0 / float(np.sqrt(hd))
+    s = jnp.einsum("bkgd,btkd->bkgt", q, kg) * scale
+    visible = jnp.arange(t)[None, :] < lengths[:, None]  # [S, T]
+    s = jnp.where(visible[:, None, None, :], s, _NEG_BIG)
+    return jnp.einsum("bkgt,btkd->bkgd", jax.nn.softmax(s, axis=-1), vg)
 
 
 def _flash_kernel(

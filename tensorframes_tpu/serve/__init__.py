@@ -1,0 +1,27 @@
+"""Serving-side generation: continuous batching over a paged KV cache.
+
+The subsystem that turns the single-shot decode path
+(:func:`~tensorframes_tpu.models.transformer_generate`) into a service:
+requests with independent arrival times and lengths share one decode
+batch and one static page pool, with exactly two compiled step programs
+for the whole lifetime. See ``docs/serving_llm.md``.
+
+- :mod:`.kv_pages` — the paged KV cache (static pool + page tables)
+- :mod:`.scheduler` — bounded admission, slots, preempt-and-requeue
+- :mod:`.engine` — the compiled prefill/decode steps + streaming API
+"""
+
+from .engine import GenerationEngine
+from .kv_pages import PagePool, SequencePages, pages_needed
+from .scheduler import GenerationHandle, GenRequest, QueueFullError, Scheduler
+
+__all__ = [
+    "GenerationEngine",
+    "GenerationHandle",
+    "GenRequest",
+    "PagePool",
+    "QueueFullError",
+    "Scheduler",
+    "SequencePages",
+    "pages_needed",
+]

@@ -1,0 +1,529 @@
+"""GenerationEngine: compiled prefill/decode steps over the paged cache.
+
+The serving counterpart of
+:func:`~tensorframes_tpu.models.transformer_generate`: where that
+function compiles one scan program per (batch shape, decode structure),
+this engine compiles exactly TWO programs for a whole serving lifetime —
+
+- **prefill** ``[1, max_seq_len]``: one right-padded prompt through the
+  batched causal pass (:func:`~tensorframes_tpu.models.transformer_prefill`),
+  its per-layer k/v scattered into the sequence's pages, the first token
+  sampled from the last real position's logits;
+- **decode** ``[max_slots]``: one token per occupied slot through the
+  shared per-token step (:func:`~tensorframes_tpu.models.transformer_step`)
+  with attention delegated to the paged read
+  (:func:`~tensorframes_tpu.ops.paged_attention`).
+
+Every input shape is static (page tables are fixed-width, idle slots
+point at the trash page), so slot turnover, ragged lengths, and
+greedy/sampled mixes all reuse the same two executables — the
+no-recompile property the ROADMAP's heavy-traffic target needs. Sampling
+parameters (temperature / seed / top_p) are per-request TRACED inputs;
+``top_k`` is engine-level static structure, as in ``generate``.
+
+Requests stream through :class:`~.scheduler.Scheduler` (bounded
+admission, continuous batching, preempt-and-requeue on page-pool
+exhaustion); each :meth:`submit` returns a
+:class:`~.scheduler.GenerationHandle` whose iterator yields tokens as
+steps complete. Observability: queue depth / batch occupancy /
+pages-in-use gauges, time-to-first-token and inter-token latency
+histograms, all on the PR-1 registry (``docs/observability.md``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.transformer import (
+    _kv_heads,
+    filter_logits,
+    transformer_prefill,
+    transformer_step,
+)
+from ..obs import span as _span
+from ..obs.metrics import (
+    counter as _counter,
+    gauge as _gauge,
+    histogram as _histogram,
+)
+from ..utils.logging import get_logger
+from .kv_pages import PagePool, pages_needed
+from .scheduler import (
+    GenerationHandle,
+    GenRequest,
+    QueueFullError,
+    Scheduler,
+    _Active,
+)
+
+__all__ = ["GenerationEngine"]
+
+logger = get_logger("serve.engine")
+
+_m_queue_depth = _gauge(
+    "serve.queue_depth", "Generation requests waiting for a decode slot"
+)
+_m_active_slots = _gauge(
+    "serve.active_slots",
+    "Decode-batch occupancy (sequences currently holding a slot)",
+)
+_m_pages_in_use = _gauge(
+    "serve.pages_in_use", "KV pages currently owned by live sequences"
+)
+_m_pages_capacity = _gauge(
+    "serve.pages_capacity", "Total KV pages in the pool"
+)
+_m_ttft = _histogram(
+    "serve.ttft_seconds",
+    "Time to first token: submit to first emission (seconds)",
+)
+_m_itl = _histogram(
+    "serve.inter_token_seconds",
+    "Inter-token latency per stream: gap between emissions (seconds)",
+)
+_m_tokens = _counter(
+    "serve.tokens_total", "Tokens emitted across all generation streams"
+)
+_m_requests = _counter(
+    "serve.requests_total",
+    "Generation requests by terminal status",
+    labels=("status",),
+)
+
+
+class GenerationEngine:
+    """Continuous-batching generation over a :class:`PagePool`.
+
+    >>> eng = GenerationEngine(lm, max_slots=8, page_size=16)
+    >>> h = eng.submit(prompt_ids, max_new_tokens=64)
+    >>> eng.start()              # background stepping (or drive .step())
+    >>> for tok in h: ...        # stream
+    >>> eng.stop()
+
+    ``model`` is a :class:`~tensorframes_tpu.models.TransformerLM` or its
+    params dict. ``max_seq_len`` bounds prompt + generation per request
+    (default: the model's positional table). ``num_pages`` defaults to
+    full-length pages for every slot (no preemption pressure); size it
+    SMALLER to oversubscribe memory and lean on preempt-and-requeue.
+    ``top_k`` is engine-static; temperature / ``top_p`` / seed are
+    per-request."""
+
+    def __init__(
+        self,
+        model,
+        *,
+        max_slots: int = 8,
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        max_seq_len: Optional[int] = None,
+        queue_capacity: int = 64,
+        top_k: int = 0,
+        eos_id: Optional[int] = None,
+        moe_top_k: int = 1,
+    ):
+        import jax
+
+        params = getattr(model, "params", model)
+        n_heads = params["n_heads"]
+        d_model = int(np.shape(params["embed"])[1])
+        hd = d_model // n_heads
+        n_kv = _kv_heads(params["blocks"][0], d_model, n_heads)
+        model_max = int(np.shape(params["pos"])[0])
+        self.max_seq_len = int(max_seq_len or model_max)
+        if self.max_seq_len > model_max:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's "
+                f"positional table ({model_max})"
+            )
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self._max_pages = pages_needed(self.max_seq_len, self.page_size)
+        if num_pages is None:
+            num_pages = self.max_slots * self._max_pages
+        self.pool = PagePool(
+            n_layers=len(params["blocks"]),
+            n_kv_heads=n_kv,
+            head_dim=hd,
+            num_pages=num_pages,
+            page_size=self.page_size,
+        )
+        self.scheduler = Scheduler(
+            self.pool, self.max_slots, queue_capacity, self.max_seq_len
+        )
+        self.top_k = int(top_k)
+        self.eos_id = eos_id
+        self._d_model = d_model
+        # weights enter the compiled steps as an ARGUMENT (swap-safe, like
+        # TransformerLM.generate); one device copy held for the lifetime
+        self._host_params = params
+        self._params_dev = jax.device_put(
+            {k: v for k, v in params.items() if k != "n_heads"}
+        )
+        # donation halves pool traffic on real chips; CPU jax warns and
+        # ignores it, so only request it where it works
+        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        self._prefill_jit = jax.jit(
+            self._prefill_impl(n_heads, moe_top_k), donate_argnums=donate
+        )
+        self._decode_jit = jax.jit(
+            self._decode_impl(n_heads, moe_top_k), donate_argnums=donate
+        )
+        #: distinct (name, abstract input signature) pairs dispatched —
+        #: jit keys compiles on exactly this, so its length IS the number
+        #: of compiled step programs
+        self.program_signatures: set = set()
+        self._req_counter = 0
+        self._submit_lock = threading.Lock()
+        self._step_lock = threading.RLock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        _m_pages_capacity.set(float(num_pages))
+
+    # -- compiled step builders -------------------------------------------
+
+    def _prefill_impl(self, n_heads: int, moe_top_k: int):
+        import jax
+        import jax.numpy as jnp
+
+        ps = self.page_size
+        trash = self.pool.trash_page
+        top_k = self.top_k
+
+        def prefill(p, kp, vp, prompt, length, ptab, temp, seed, top_p):
+            full = {**p, "n_heads": n_heads}
+            logits, kc, vc = transformer_prefill(
+                full, prompt, moe_top_k=moe_top_k
+            )
+            # [L, 1, n_kv, Pmax, hd] -> [L, Pmax, n_kv, hd]; positions
+            # past the real prompt scatter into the trash page
+            k_all = kc[:, 0].transpose(0, 2, 1, 3)
+            v_all = vc[:, 0].transpose(0, 2, 1, 3)
+            pos = jnp.arange(prompt.shape[1])
+            page = jnp.where(pos < length, ptab[pos // ps], trash)
+            off = pos % ps
+            kp = kp.at[:, page, off].set(k_all)
+            vp = vp.at[:, page, off].set(v_all)
+            last = logits[0, length - 1]
+            greedy = jnp.argmax(last, axis=-1)
+            # sampled path mirrors generate: per-step key folded at the
+            # emitting position, filter_logits truncation, categorical
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), length - 1)
+            scaled = last[None] / jnp.maximum(
+                jnp.asarray(temp, jnp.float32), 1e-6
+            )
+            filt = filter_logits(scaled, top_k=top_k, top_p=top_p)
+            sampled = jax.random.categorical(key, filt, axis=-1)[0]
+            tok = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+            return kp, vp, tok
+
+        return prefill
+
+    def _decode_impl(self, n_heads: int, moe_top_k: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import paged_attention
+
+        ps = self.page_size
+        d_model = self._d_model
+        top_k = self.top_k
+
+        def decode(p, kp, vp, toks, positions, ptabs, temps, seeds, top_ps):
+            full = {**p, "n_heads": n_heads}
+            slots = toks.shape[0]
+            state = [kp, vp]
+
+            def attend(li, q, k, v):
+                # write this token's k/v into its page, then read the
+                # whole visible history through the page table
+                page = ptabs[jnp.arange(slots), positions // ps]
+                off = positions % ps
+                state[0] = state[0].at[li, page, off].set(k)
+                state[1] = state[1].at[li, page, off].set(v)
+                ctx = paged_attention(
+                    q, state[0][li], state[1][li], ptabs, positions + 1
+                )
+                return ctx.reshape(slots, d_model)
+
+            logits = transformer_step(
+                full, toks, positions, attend, moe_top_k=moe_top_k
+            )
+            greedy = jnp.argmax(logits, axis=-1)
+            keys = jax.vmap(
+                lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
+            )(seeds, positions)
+            scaled = logits / jnp.maximum(temps[:, None], 1e-6)
+            filt = filter_logits(scaled, top_k=top_k, top_p=top_ps[:, None])
+            sampled = jax.vmap(jax.random.categorical)(keys, filt)
+            nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            return state[0], state[1], nxt
+
+        return decode
+
+    def _record_program(self, name: str, *args) -> None:
+        sig: List = [name]
+        for a in args:
+            if isinstance(a, dict):
+                sig.append("params")
+            else:
+                arr = np.asarray(a) if np.isscalar(a) else a
+                sig.append((tuple(arr.shape), str(arr.dtype)))
+        self.program_signatures.add(tuple(sig))
+
+    @property
+    def num_step_programs(self) -> int:
+        """Distinct compiled step programs dispatched so far (jit keys on
+        the abstract input signature; static shapes keep this at <= 2:
+        one prefill + one decode)."""
+        return len(self.program_signatures)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        eos_id: Optional[int] = None,
+        block: bool = True,
+        timeout: Optional[float] = None,
+    ) -> GenerationHandle:
+        """Queue one generation request; returns its streaming handle.
+        Raises ``ValueError`` for requests that could never be scheduled
+        and :class:`~.scheduler.QueueFullError` when the bounded queue is
+        full and ``block=False``."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if prompt.size < 1:
+            _m_requests.inc(status="rejected")
+            raise ValueError("prompt needs at least one token")
+        if max_new_tokens < 1:
+            _m_requests.inc(status="rejected")
+            raise ValueError(
+                f"max_new_tokens must be >= 1; got {max_new_tokens}"
+            )
+        with self._submit_lock:
+            self._req_counter += 1
+            rid = self._req_counter
+        handle = GenerationHandle(rid)
+        req = GenRequest(
+            request_id=rid,
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature),
+            top_p=float(top_p),
+            seed=int(seed),
+            eos_id=self.eos_id if eos_id is None else eos_id,
+            handle=handle,
+        )
+        try:
+            self.scheduler.submit(req, block=block, timeout=timeout)
+        except (ValueError, QueueFullError):
+            # both are terminal rejections from the caller's view —
+            # infeasible shape and queue backpressure alike must keep
+            # completed + failed + rejected == submissions
+            _m_requests.inc(status="rejected")
+            raise
+        _m_queue_depth.set(float(self.scheduler.queue_depth))
+        return handle
+
+    # -- stepping ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit + prefill newcomers, grow pages
+        (preempting on exhaustion), one decode step for the batch.
+        Returns whether work remains. Exceptions from the device fail the
+        affected requests' handles and re-raise."""
+        with self._step_lock:
+            prefill_err: Optional[BaseException] = None
+            for idx, act in self.scheduler.admit():
+                try:
+                    self._prefill_one(idx, act)
+                except Exception as e:
+                    # fail THIS request only and keep admitting: aborting
+                    # mid-loop would leave later-admitted slots with no
+                    # prefill (empty ``generated``), poisoning the next
+                    # decode batch
+                    self.scheduler.finish(idx, error=e)
+                    _m_requests.inc(status="failed")
+                    if prefill_err is None:
+                        prefill_err = e
+            if prefill_err is not None:
+                # every surviving slot is prefilled; propagate now, before
+                # decode, so synchronous drivers see the device error
+                self._refresh_gauges()
+                raise prefill_err
+            batch = self.scheduler.active
+            if batch:
+                ready: List[Tuple[int, _Active]] = []
+                for idx, act in batch:
+                    if self.scheduler.slots[idx] is not act:
+                        continue  # preempted as a victim already
+                    if self.scheduler.grow(idx):
+                        ready.append((idx, act))
+                # growth for a later slot may have evicted an earlier one
+                ready = [
+                    (i, a) for i, a in ready if self.scheduler.slots[i] is a
+                ]
+                if ready:
+                    try:
+                        self._decode_batch(ready)
+                    except Exception as e:
+                        for i, _ in ready:
+                            if self.scheduler.slots[i] is not None:
+                                self.scheduler.finish(i, error=e)
+                                _m_requests.inc(status="failed")
+                        raise
+            self._refresh_gauges()
+            return self.scheduler.has_work()
+
+    def _prefill_one(self, idx: int, act: _Active) -> None:
+        req = act.req
+        plen = len(req.prompt)
+        prompt_row = np.zeros((1, self.max_seq_len), np.int32)
+        prompt_row[0, :plen] = req.prompt
+        ptab = act.seq.table(self._max_pages)
+        args = (
+            prompt_row,
+            np.int32(plen),
+            ptab,
+            np.float32(req.temperature),
+            np.int32(req.seed),
+            np.float32(req.top_p),
+        )
+        pool = self.pool
+        self._record_program("prefill", self._params_dev, pool.k, *args)
+        with _span("serve.prefill", request=req.request_id, prompt_len=plen):
+            pool.k, pool.v, tok = self._prefill_jit(
+                self._params_dev, pool.k, pool.v, *args
+            )
+        self._emit(idx, act, int(tok))
+
+    def _decode_batch(self, ready: List[Tuple[int, _Active]]) -> None:
+        s = self.max_slots
+        toks = np.zeros(s, np.int32)
+        positions = np.zeros(s, np.int32)
+        ptabs = np.full(
+            (s, self._max_pages), self.pool.trash_page, np.int32
+        )
+        temps = np.zeros(s, np.float32)
+        seeds = np.zeros(s, np.int32)
+        top_ps = np.ones(s, np.float32)
+        for idx, act in ready:
+            toks[idx] = act.generated[-1]
+            positions[idx] = act.length - 1  # this token's write position
+            ptabs[idx] = act.seq.table(self._max_pages)
+            temps[idx] = act.req.temperature
+            seeds[idx] = act.req.seed
+            top_ps[idx] = act.req.top_p
+        args = (toks, positions, ptabs, temps, seeds, top_ps)
+        pool = self.pool
+        self._record_program("decode", self._params_dev, pool.k, *args)
+        with _span("serve.decode_step", occupancy=len(ready)):
+            pool.k, pool.v, nxt = self._decode_jit(
+                self._params_dev, pool.k, pool.v, *args
+            )
+        nxt = np.asarray(nxt)
+        for idx, act in ready:
+            self._emit(idx, act, int(nxt[idx]))
+
+    def _emit(self, idx: int, act: _Active, tok: int) -> None:
+        now = time.monotonic()
+        act.generated.append(tok)
+        act.req.handle._emit(tok)
+        _m_tokens.inc()
+        if act.req.emitted == 0 and len(act.generated) == 1:
+            _m_ttft.observe(now - act.req.submitted_at)
+        elif act.last_emit_t is not None:
+            _m_itl.observe(now - act.last_emit_t)
+        act.last_emit_t = now
+        eos = act.req.eos_id
+        if (eos is not None and tok == eos) or act.remaining <= 0:
+            self.scheduler.finish(idx)
+            _m_requests.inc(status="completed")
+
+    def _refresh_gauges(self) -> None:
+        _m_queue_depth.set(float(self.scheduler.queue_depth))
+        _m_active_slots.set(
+            float(sum(s is not None for s in self.scheduler.slots))
+        )
+        _m_pages_in_use.set(float(self.pool.pages_in_use))
+
+    def run_until_idle(self) -> None:
+        """Drive :meth:`step` until queue and slots are empty (the
+        synchronous mode — tests and batch jobs)."""
+        while self.step():
+            pass
+
+    def defragment(self):
+        """Compact live KV pages to the lowest pool indices between steps
+        (page tables are rebuilt from the sequences every step, so the
+        renumbering is transparent to in-flight generation). Returns the
+        ``old -> new`` page remap. See :meth:`PagePool.defragment`."""
+        with self._step_lock:
+            return self.pool.defragment(
+                [a.seq for _, a in self.scheduler.active]
+            )
+
+    # -- background serving ------------------------------------------------
+
+    def start(self) -> "GenerationEngine":
+        """Step in a daemon thread until :meth:`stop` — the serving mode
+        (pair with the scoring server's generate endpoint)."""
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    worked = self.step()
+                except Exception:
+                    logger.warning(
+                        "generation step failed", exc_info=True
+                    )
+                    worked = True  # the failed batch was cleared; go on
+                if not worked:
+                    with self.scheduler._lock:
+                        if not self.scheduler._waiting:
+                            self.scheduler._lock.wait(0.02)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        with self.scheduler._lock:
+            self.scheduler._lock.notify_all()
+        self._thread.join(timeout=10)
+        self._thread = None
+
+    def __enter__(self) -> "GenerationEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- convenience -------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+        **kw,
+    ) -> List[np.ndarray]:
+        """Submit every prompt, run to completion, return each request's
+        generated tokens (prompt excluded). Synchronous when no
+        background thread is running."""
+        handles = [self.submit(p, max_new_tokens, **kw) for p in prompts]
+        if self._thread is None:
+            self.run_until_idle()
+        return [h.result(timeout=300) for h in handles]

@@ -1,0 +1,219 @@
+"""Paged KV cache: a static-shape page pool + per-sequence page tables.
+
+The decode-memory problem continuous batching creates: requests arrive
+and finish at different times with different lengths, but a compiled
+decode step wants ONE static cache shape. A per-request contiguous cache
+(what :func:`~tensorframes_tpu.models.transformer_generate` allocates)
+either recompiles as shapes change or wastes ``max_len`` rows per slot.
+The paged layout (Ragged Paged Attention / vLLM's PagedAttention, see
+PAPERS.md) decouples the two lifetimes:
+
+- **device**: one pool of ``num_pages`` fixed-size pages per layer,
+  ``[n_layers, num_pages + 1, page_size, n_kv_heads, head_dim]`` — the
+  shape never changes, so the decode step compiles exactly once. The
+  extra row at index ``num_pages`` is the TRASH page: writes from
+  inactive slots and prompt padding land there, keeping every program
+  input in-bounds without per-slot branches.
+- **host**: a free-list allocator and per-sequence page tables
+  (:class:`SequencePages`). Sequences grow one page at a time; a
+  finished sequence's pages return to the pool immediately, so HBM is
+  bounded by LIVE tokens, not by slots × max_len.
+
+Exhaustion raises
+:class:`~tensorframes_tpu.utils.failures.PagePoolExhausted` — the
+scheduler's cue to preempt-and-requeue, never a crash.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Sequence
+
+import numpy as np
+
+from ..utils.failures import PagePoolExhausted
+
+__all__ = ["PagePool", "SequencePages", "pages_needed"]
+
+
+def pages_needed(tokens: int, page_size: int) -> int:
+    """Pages required to hold ``tokens`` positions."""
+    return -(-int(tokens) // int(page_size))
+
+
+class PagePool:
+    """Fixed-size KV page pool: device arrays with a STATIC shape plus a
+    host-side free-list allocator.
+
+    ``k``/``v`` are ``[n_layers, num_pages + 1, page_size, n_kv_heads,
+    head_dim]`` jax arrays — page ``num_pages`` is the trash page (see
+    module docstring). The arrays are exposed as plain attributes because
+    the engine's compiled step functions consume and return them
+    functionally (donated on TPU); the pool only tracks WHICH pages are
+    live, never their contents."""
+
+    def __init__(
+        self,
+        n_layers: int,
+        n_kv_heads: int,
+        head_dim: int,
+        num_pages: int,
+        page_size: int,
+        dtype=None,
+    ):
+        import jax.numpy as jnp
+
+        if num_pages < 1 or page_size < 1:
+            raise ValueError(
+                f"need num_pages >= 1 and page_size >= 1; got "
+                f"{num_pages}, {page_size}"
+            )
+        self.n_layers = int(n_layers)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        #: index of the trash page (valid to write, never read unmasked)
+        self.trash_page = self.num_pages
+        shape = (
+            self.n_layers,
+            self.num_pages + 1,
+            self.page_size,
+            self.n_kv_heads,
+            self.head_dim,
+        )
+        dtype = jnp.float32 if dtype is None else dtype
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self._lock = threading.Lock()
+        # LIFO free list: recently-freed pages are reused first (their
+        # contents are hottest in any cache hierarchy, and reuse keeps
+        # the live set compact without explicit defragmentation). The
+        # shadow set makes the double-free guard O(1) per page — free()
+        # sits on the request-finish/preempt hot path.
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+        self._free_set = set(self._free)
+
+    # -- allocation --------------------------------------------------------
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """Take ``n`` pages off the free list — all or nothing (a partial
+        grant would leak pages when the caller unwinds). Raises
+        :class:`PagePoolExhausted` when fewer than ``n`` are free."""
+        with self._lock:
+            if n > len(self._free):
+                raise PagePoolExhausted(
+                    f"KV page pool exhausted: need {n} page(s), "
+                    f"{len(self._free)}/{self.num_pages} free"
+                )
+            grant = self._free[-n:][::-1]
+            del self._free[len(self._free) - n :]
+            self._free_set.difference_update(grant)
+            return grant
+
+    def free(self, pages: Iterable[int]) -> None:
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if not 0 <= p < self.num_pages:
+                    raise ValueError(f"page {p} is not a pool page")
+                if p in self._free_set:
+                    raise ValueError(f"double free of page {p}")
+                self._free.append(p)
+                self._free_set.add(p)
+
+    @property
+    def pages_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - self.pages_free
+
+    # -- defragmentation ---------------------------------------------------
+
+    def defragment(
+        self, sequences: Sequence["SequencePages"]
+    ) -> Dict[int, int]:
+        """Compact every live page to the lowest pool indices: one device
+        gather per pool array rewrites page CONTENTS, and each sequence's
+        table is renumbered in place. Returns the ``old -> new`` remap.
+
+        With an indirection table any free page is as good as any other,
+        so steady-state serving never needs this; it exists for pool
+        RESIZE (shrink to the live prefix, then slice the arrays) and for
+        snapshot/restore, where a contiguous live region is the useful
+        invariant."""
+        with self._lock:
+            live: List[int] = []
+            for seq in sequences:
+                live.extend(seq.pages)
+            if len(set(live)) != len(live):
+                raise ValueError("a page is owned by two sequences")
+            remap = {old: new for new, old in enumerate(sorted(live))}
+            # perm[new] = old for live pages; free pages fill the tail in
+            # index order; trash stays trash
+            tail = [p for p in range(self.num_pages) if p not in remap]
+            perm = np.empty(self.num_pages + 1, np.int32)
+            for old, new in remap.items():
+                perm[new] = old
+            perm[len(remap) : self.num_pages] = tail
+            perm[self.num_pages] = self.trash_page
+            self.k = self.k[:, perm]
+            self.v = self.v[:, perm]
+            for seq in sequences:
+                seq.pages = [remap[p] for p in seq.pages]
+            self._free = list(range(self.num_pages - 1, len(remap) - 1, -1))
+            self._free_set = set(self._free)
+            return remap
+
+    def __repr__(self) -> str:
+        return (
+            f"PagePool(pages={self.num_pages}, page_size={self.page_size}, "
+            f"in_use={self.pages_in_use})"
+        )
+
+
+class SequencePages:
+    """One sequence's slice of the pool: the ordered page list (page ``i``
+    holds positions ``i*page_size .. (i+1)*page_size - 1``) and growth /
+    release bookkeeping. Pure host state — the device-visible form is
+    :meth:`table`."""
+
+    def __init__(self, pool: PagePool):
+        self.pool = pool
+        self.pages: List[int] = []
+
+    @property
+    def capacity(self) -> int:
+        """Token positions the currently-held pages can store."""
+        return len(self.pages) * self.pool.page_size
+
+    def ensure(self, tokens: int) -> None:
+        """Grow the page list until ``tokens`` positions fit. All-or-
+        nothing per call; raises :class:`PagePoolExhausted` (holdings
+        unchanged) when the pool cannot supply the missing pages."""
+        missing = pages_needed(tokens, self.pool.page_size) - len(self.pages)
+        if missing > 0:
+            self.pages.extend(self.pool.alloc(missing))
+
+    def release(self) -> None:
+        """Return every held page to the pool (idempotent)."""
+        if self.pages:
+            self.pool.free(self.pages)
+            self.pages = []
+
+    def table(self, max_pages: int) -> np.ndarray:
+        """The ``[max_pages]`` int32 page table the compiled step reads —
+        held pages in position order, trash-filled past the end (those
+        entries are masked by the position mask, but must stay in
+        bounds)."""
+        if len(self.pages) > max_pages:
+            raise ValueError(
+                f"sequence holds {len(self.pages)} pages > max_pages "
+                f"{max_pages}"
+            )
+        out = np.full(max_pages, self.pool.trash_page, np.int32)
+        out[: len(self.pages)] = self.pages
+        return out

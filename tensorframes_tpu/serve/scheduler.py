@@ -1,0 +1,343 @@
+"""Continuous-batching scheduler: admission queue, slots, preemption.
+
+Pure host logic — no jax imports — so the batching policy is unit-
+testable without compiling anything. The
+:class:`~tensorframes_tpu.serve.engine.GenerationEngine` drives it:
+
+- :meth:`Scheduler.submit` parks requests in a BOUNDED admission queue
+  (a full queue rejects or blocks — backpressure instead of unbounded
+  host memory, the same stance the scoring server takes with its
+  connection semaphore).
+- :meth:`Scheduler.admit` moves queued requests into free decode slots,
+  reserving prompt pages; the engine then prefills each admission.
+- :meth:`Scheduler.grow` reserves the next decode position's page for a
+  running sequence; on :class:`PagePoolExhausted` it PREEMPTS the
+  youngest other sequence — pages freed, request requeued at the FRONT
+  of the queue with its progress folded into the prompt (recompute-style
+  preemption: the re-admitted prefill replays prompt + emitted tokens,
+  so the consumer's stream continues without replay or loss).
+
+Preemption rides the failure taxonomy in ``utils/failures.py``
+(:func:`record_preemption`, :class:`PagePoolExhausted`) — pool
+exhaustion is a RESOURCE_EXHAUSTED condition the scheduler degrades
+through, never a crash.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.failures import PagePoolExhausted, record_preemption
+from .kv_pages import PagePool, SequencePages, pages_needed
+
+__all__ = [
+    "GenerationHandle",
+    "GenRequest",
+    "QueueFullError",
+    "Scheduler",
+]
+
+
+class QueueFullError(RuntimeError):
+    """The bounded admission queue is at capacity (non-blocking submit)."""
+
+
+class GenerationHandle:
+    """The caller's end of one request: a token stream plus completion
+    state. Iterating yields generated token ids as the engine emits them;
+    :meth:`result` blocks for the full generation."""
+
+    _DONE = object()
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._q: "queue.Queue" = queue.Queue()
+        self._tokens: List[int] = []
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    # -- engine side -------------------------------------------------------
+
+    def _emit(self, token: int) -> None:
+        self._tokens.append(int(token))
+        self._q.put(int(token))
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        self._error = error
+        self._done.set()
+        self._q.put(self._DONE)
+
+    # -- caller side -------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Generated tokens (prompt excluded), blocking until done."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not done within {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return np.asarray(self._tokens, np.int32)
+
+
+@dataclass
+class GenRequest:
+    """One admission-queue entry. ``prompt`` already includes any tokens
+    generated before a preemption (recompute-style requeue), and
+    ``emitted`` counts them so re-admission emits only NEW tokens."""
+
+    request_id: int
+    prompt: np.ndarray  # [plen] int32
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    handle: GenerationHandle = None  # type: ignore[assignment]
+    submitted_at: float = field(default_factory=time.monotonic)
+    emitted: int = 0  # tokens already streamed (pre-preemption progress)
+
+
+class _Active:
+    """A slot's running sequence: request + page holdings + progress."""
+
+    __slots__ = ("req", "seq", "generated", "admit_order", "last_emit_t")
+
+    def __init__(self, req: GenRequest, seq: SequencePages, admit_order: int):
+        self.req = req
+        self.seq = seq
+        self.generated: List[int] = []
+        self.admit_order = admit_order
+        self.last_emit_t: Optional[float] = None
+
+    @property
+    def length(self) -> int:
+        """Positions written to the KV pages so far."""
+        return len(self.req.prompt) + len(self.generated)
+
+    @property
+    def remaining(self) -> int:
+        return self.req.max_new_tokens - len(self.generated)
+
+
+class Scheduler:
+    """Slot + queue + page bookkeeping for one decode batch. Thread-safe
+    for concurrent :meth:`submit`; the step-side methods (:meth:`admit`,
+    :meth:`grow`, :meth:`finish`) are called by the engine's single
+    stepping thread."""
+
+    def __init__(
+        self,
+        pool: PagePool,
+        max_slots: int,
+        queue_capacity: int,
+        max_seq_len: int,
+    ):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1; got {max_slots}")
+        self.pool = pool
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(max_seq_len)
+        self.queue_capacity = int(queue_capacity)
+        self.slots: List[Optional[_Active]] = [None] * self.max_slots
+        self._waiting: Deque[GenRequest] = deque()
+        self._lock = threading.Condition()
+        self._admit_counter = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(
+        self,
+        req: GenRequest,
+        block: bool = True,
+        timeout: Optional[float] = None,
+    ) -> None:
+        """Park ``req`` in the admission queue. A full queue blocks (the
+        default — backpressure to the producer) or raises
+        :class:`QueueFullError` with ``block=False``."""
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(req.prompt)}) + max_new_tokens "
+                f"({req.max_new_tokens}) = {total} exceeds max_seq_len "
+                f"{self.max_seq_len}"
+            )
+        if pages_needed(total, self.pool.page_size) > self.pool.num_pages:
+            raise ValueError(
+                f"request needs {pages_needed(total, self.pool.page_size)} "
+                f"pages at full length but the pool holds only "
+                f"{self.pool.num_pages} — it could never be scheduled"
+            )
+        with self._lock:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while len(self._waiting) >= self.queue_capacity:
+                if not block:
+                    raise QueueFullError(
+                        f"admission queue full "
+                        f"({self.queue_capacity} requests waiting)"
+                    )
+                rem = None if deadline is None else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    raise QueueFullError(
+                        f"admission queue still full after {timeout}s"
+                    )
+                self._lock.wait(rem)
+            self._waiting.append(req)
+            self._lock.notify_all()
+
+    def _requeue_front(self, req: GenRequest) -> None:
+        """Preempted requests skip the line — they already waited once and
+        hold the earliest arrival times. The queue bound is deliberately
+        ignored here: a preemption must never deadlock against a full
+        queue (the pages are already released; the request has nowhere
+        else to live)."""
+        with self._lock:
+            self._waiting.appendleft(req)
+            self._lock.notify_all()
+
+    # -- stepping side -----------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._waiting)
+
+    @property
+    def active(self) -> List[Tuple[int, _Active]]:
+        """(slot index, active sequence) pairs, oldest admission first —
+        the decode order, and the inverse of the preemption order."""
+        pairs = [
+            (i, a) for i, a in enumerate(self.slots) if a is not None
+        ]
+        pairs.sort(key=lambda p: p[1].admit_order)
+        return pairs
+
+    def has_work(self) -> bool:
+        return any(s is not None for s in self.slots) or self.queue_depth > 0
+
+    def admit(self) -> List[Tuple[int, _Active]]:
+        """Fill free slots from the queue head, reserving each admitted
+        prompt's pages. Stops at the first request whose prompt pages the
+        pool cannot supply right now (it keeps its queue position; active
+        sequences finishing will free pages — preemption is only for
+        sequences already mid-flight, see :meth:`grow`). Returns the new
+        (slot, active) pairs for the engine to prefill."""
+        admitted: List[Tuple[int, _Active]] = []
+        for idx in range(self.max_slots):
+            if self.slots[idx] is not None:
+                continue
+            with self._lock:
+                if not self._waiting:
+                    break
+                req = self._waiting.popleft()
+                self._lock.notify_all()
+            seq = SequencePages(self.pool)
+            try:
+                seq.ensure(len(req.prompt))
+            except PagePoolExhausted:
+                seq.release()
+                self._requeue_front(req)
+                break
+            act = _Active(req, seq, self._admit_counter)
+            self._admit_counter += 1
+            self.slots[idx] = act
+            admitted.append((idx, act))
+        return admitted
+
+    def grow(self, idx: int) -> bool:
+        """Reserve the page holding slot ``idx``'s next decode position,
+        preempting the YOUNGEST other active sequence per retry until the
+        pool yields one. Returns False when ``idx``'s own sequence got
+        preempted (it was the youngest left — the caller drops it from
+        this step's batch)."""
+        act = self.slots[idx]
+        assert act is not None
+        while True:
+            try:
+                # the pending token writes at position length - 1 (its
+                # ``generated`` entry exists but is not yet in the cache)
+                act.seq.ensure(act.length)
+                return True
+            except PagePoolExhausted:
+                victim_idx = self._youngest_active(exclude=idx)
+                if victim_idx is None:
+                    # nothing left to evict but the requester itself; its
+                    # full-length feasibility was checked at submit, so
+                    # alone it always fits — reaching here means it is
+                    # NOT alone in page ownership yet no slot can be
+                    # preempted, which cannot happen with slot-owned pages
+                    self.preempt(idx)
+                    return False
+                self.preempt(victim_idx)
+
+    def _youngest_active(self, exclude: int) -> Optional[int]:
+        """Most recently admitted slot other than ``exclude`` — the
+        preemption victim (least progress lost, and the inverse of
+        admission order keeps the policy starvation-free: the evicted
+        request re-enters at the queue FRONT)."""
+        best, best_order = None, -1
+        for i, a in enumerate(self.slots):
+            if a is None or i == exclude:
+                continue
+            if a.admit_order > best_order:
+                best, best_order = i, a.admit_order
+        return best
+
+    def preempt(self, idx: int) -> GenRequest:
+        """Evict slot ``idx``: release its pages and requeue the request
+        at the queue front with progress folded into the prompt (the
+        handle keeps streaming; re-admission emits only new tokens)."""
+        act = self.slots[idx]
+        assert act is not None
+        act.seq.release()
+        self.slots[idx] = None
+        req = act.req
+        new_req = GenRequest(
+            request_id=req.request_id,
+            prompt=np.concatenate(
+                [req.prompt, np.asarray(act.generated, np.int32)]
+            ),
+            max_new_tokens=req.max_new_tokens - len(act.generated),
+            temperature=req.temperature,
+            top_p=req.top_p,
+            seed=req.seed,
+            eos_id=req.eos_id,
+            handle=req.handle,
+            submitted_at=req.submitted_at,
+            emitted=req.emitted + len(act.generated),
+        )
+        record_preemption("serve")
+        self._requeue_front(new_req)
+        return new_req
+
+    def finish(self, idx: int, error: Optional[BaseException] = None) -> None:
+        """Terminal slot release: pages back to the pool, handle closed."""
+        act = self.slots[idx]
+        assert act is not None
+        act.seq.release()
+        self.slots[idx] = None
+        act.req.handle._finish(error)

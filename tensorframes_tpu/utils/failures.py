@@ -40,7 +40,9 @@ __all__ = [
     "is_transient",
     "run_with_retries",
     "record_oom_split",
+    "record_preemption",
     "DeviceOOMError",
+    "PagePoolExhausted",
 ]
 
 logger = get_logger("failures")
@@ -65,6 +67,11 @@ _oom_splits_total = _counter(
     "OOM-degrade work-unit splits (chunk halvings / cap lowerings), by op",
     labels=("op",),
 )
+_preemptions_total = _counter(
+    "failures.preemptions_total",
+    "Work units preempted and requeued on resource exhaustion, by op",
+    labels=("op",),
+)
 
 
 def record_oom_split(op: str) -> None:
@@ -72,6 +79,14 @@ def record_oom_split(op: str) -> None:
     engine (``map_rows`` chunk halving, raised-chunk lowering); the counter
     lives here with the rest of the failure telemetry."""
     _oom_splits_total.inc(op=op)
+
+
+def record_preemption(op: str) -> None:
+    """Count one preempt-and-requeue. Like :func:`record_oom_split`, the
+    preemption itself happens at the resource owner (the serving
+    scheduler evicting a sequence when its KV page pool runs dry); the
+    counter lives here with the rest of the failure telemetry."""
+    _preemptions_total.inc(op=op)
 
 T = TypeVar("T")
 
@@ -99,7 +114,18 @@ class DeviceOOMError(RuntimeError):
     """Device memory exhausted and the op cannot shrink its work unit."""
 
 
+class PagePoolExhausted(DeviceOOMError):
+    """The serving engine's KV page pool has no free page for a growing
+    sequence. A RESOURCE_EXHAUSTED sibling, but of a pool this framework
+    owns: retrying identically cannot help, and the remedy is not a
+    split but an eviction — the scheduler preempts a running sequence
+    (freeing its pages) and requeues it for recompute rather than
+    crashing the batch (see :mod:`tensorframes_tpu.serve.scheduler`)."""
+
+
 def is_oom(e: BaseException) -> bool:
+    if isinstance(e, DeviceOOMError):
+        return True
     s = str(e)
     return any(m in s for m in _OOM_MARKERS)
 

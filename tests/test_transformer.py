@@ -384,6 +384,113 @@ class TestSamplingFilters:
         assert len(lm._generate_cache) == 1
 
 
+class TestFilterLogitsEdgeCases:
+    """filter_logits edge cases that matter to serving: deterministic
+    top_k=1, tie-breaking exactly at the nucleus boundary, and the
+    traced-scalar top_p contract under jit. Fast (pure functions + one
+    tiny decode) so tier-1 keeps covering them."""
+
+    def test_top_k_1_filter_keeps_argmax_only(self):
+        from tensorframes_tpu.models import filter_logits
+
+        logits = jnp.asarray([[0.5, 2.0, 1.0], [3.0, -1.0, 2.5]])
+        out = np.asarray(filter_logits(logits, top_k=1))
+        kept = out > -1e30
+        np.testing.assert_array_equal(
+            kept, [[False, True, False], [True, False, False]]
+        )
+
+    def test_top_k_1_sampling_equals_greedy_generate(self):
+        # tiny end-to-end confirmation: with only the argmax surviving,
+        # ANY temperature samples the greedy stream
+        rng = np.random.default_rng(21)
+        lm = TransformerLM.init(3, 16, d_model=8, n_heads=2, max_len=12)
+        p = rng.integers(0, 16, size=(1, 3)).astype(np.int32)
+        np.testing.assert_array_equal(
+            lm.generate(p, 4, temperature=2.0, seed=5, top_k=1),
+            lm.generate(p, 4),
+        )
+
+    def test_top_p_ties_at_nucleus_boundary_all_survive(self):
+        from tensorframes_tpu.models import filter_logits
+
+        # two EXACTLY tied logits, each with softmax mass 0.5 - eps: the
+        # nucleus needs only the first, but masking is threshold-based
+        # (logits < thresh), so its equal twin must survive too — a
+        # sampled tie must never depend on sort order
+        logits = jnp.asarray([[0.0, 0.0, -40.0]])
+        out = np.asarray(filter_logits(logits, top_p=0.5))
+        kept = out > -1e30
+        np.testing.assert_array_equal(kept, [[True, True, False]])
+
+    def test_top_p_boundary_mass_counts_strictly_before(self):
+        from tensorframes_tpu.models import filter_logits
+
+        # masses ~[.665, .245, .090]: top_p=0.7 keeps token 1 (mass
+        # BEFORE it is .665 < .7) but drops token 2 (mass before .910)
+        logits = jnp.asarray([[2.0, 1.0, 0.0]])
+        out = np.asarray(filter_logits(logits, top_p=0.7))
+        kept = out > -1e30
+        np.testing.assert_array_equal(kept, [[True, True, False]])
+
+    def test_traced_scalar_top_p_inside_jit(self):
+        import jax
+
+        from tensorframes_tpu.models import filter_logits
+
+        calls = {"n": 0}
+
+        def impl(logits, top_p):
+            calls["n"] += 1
+            return filter_logits(logits, top_p=top_p)
+
+        f = jax.jit(impl)
+        logits = jnp.asarray([[2.0, 1.0, 0.0, -1.0]])
+        for tp, want_kept in ((0.7, 2), (0.95, 3), (1.0, 4)):
+            out = np.asarray(f(logits, jnp.float32(tp)))
+            assert (out > -1e30).sum() == want_kept, tp
+            np.testing.assert_array_equal(
+                out, np.asarray(filter_logits(logits, top_p=tp))
+            )
+        assert calls["n"] == 1  # one trace serves the whole sweep
+
+
+class TestRaggedAgreementFast:
+    """left_pad_prompts + prompt_lengths: a ragged batch must reproduce
+    each row's solo decode token-for-token at temperature 0 (the fast
+    tier-1 sibling of the slow TestRaggedPrompts suite)."""
+
+    def test_left_pad_layout_agrees_with_lengths(self):
+        from tensorframes_tpu.models import left_pad_prompts
+
+        seqs = [[4], [1, 2, 3, 4], [9, 8]]
+        packed, lens = left_pad_prompts(seqs, pad_id=7)
+        np.testing.assert_array_equal(lens, [1, 4, 2])
+        for row, s, n in zip(packed, seqs, lens):
+            assert n == len(s)
+            np.testing.assert_array_equal(row[len(row) - n :], s)
+            assert all(row[: len(row) - n] == 7)
+
+    def test_ragged_batch_matches_per_row_solo_decode(self):
+        from tensorframes_tpu.models import left_pad_prompts
+
+        rng = np.random.default_rng(22)
+        lm = TransformerLM.init(9, 16, d_model=8, n_heads=2, max_len=16)
+        seqs = [
+            rng.integers(0, 16, size=n).astype(np.int32).tolist()
+            for n in (1, 4, 2)
+        ]
+        packed, lens = left_pad_prompts(seqs)
+        batch = lm.generate(packed, 4, prompt_lengths=lens)
+        plen = packed.shape[1]
+        for i, s in enumerate(seqs):
+            solo = lm.generate(np.asarray([s], np.int32), 4)
+            np.testing.assert_array_equal(
+                batch[i, plen:], solo[0, len(s):],
+                err_msg=f"row {i} (len {len(s)})",
+            )
+
+
 @pytest.mark.slow
 class TestRaggedPrompts:
     """Left-padded variable-length prompt batches: each row must decode
