@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-multihost verify bench bench-serve bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-chaos test-multihost verify bench bench-serve bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -33,6 +33,12 @@ test-fast:
 # PR) — run this before shipping so local numbers match CI's
 verify:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# the seeded fault-injection suite (utils/chaos.py + the serving
+# supervisor under chaos) — fast, CPU-only, deterministic; part of
+# tier-1, runnable alone when iterating on failure handling
+test-chaos:
+	$(PY) -m pytest tests/ -q -m chaos
 
 # just the real 2-process distributed suite
 test-multihost:

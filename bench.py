@@ -349,6 +349,8 @@ def main_decode_serve():
             lm, c, plen=plen, max_new=max_new, seed=c
         )
     head = levels["16"]
+    from tensorframes_tpu.utils import chaos
+
     print(
         json.dumps(
             {
@@ -364,6 +366,10 @@ def main_decode_serve():
                     "model": "d128 h8 L4 vocab256",
                     "device": str(jax.devices()[0]),
                     "concurrency": levels,
+                    # a chaos-tainted number must never be mistaken for a
+                    # clean one (the injection sites sit on this path; the
+                    # disabled check is the measured-as-free case)
+                    "chaos": chaos.active_spec() or "off",
                 },
             }
         )

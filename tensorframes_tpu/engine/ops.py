@@ -660,10 +660,15 @@ def map_blocks(
             feed = {ph: feeders[ph](lo, hi) for ph in binding}
             feed.update(const_feed)
             from ..utils import is_oom, run_with_retries
+            from ..utils.chaos import site as _chaos_site
+
+            def dispatch():
+                _chaos_site("engine.dispatch")
+                return jit_fn(feed)
 
             try:
                 return run_with_retries(
-                    lambda: jit_fn(feed), what=f"map_blocks partition {p}"
+                    dispatch, what=f"map_blocks partition {p}"
                 )
             except Exception as e:
                 if is_oom(e):
@@ -1054,6 +1059,9 @@ def _map_rows_thunk(
             def dispatch():
                 import jax
 
+                from ..utils.chaos import site as _chaos_site
+
+                _chaos_site("engine.dispatch")
                 # sync INSIDE the retry window: jax dispatch is async, so
                 # without this the failure would surface at np.asarray
                 # below, past the handlers. The chunk is materialized to
@@ -1150,11 +1158,17 @@ def _map_rows_thunk(
                 pieces: Dict[str, List] = {name: [] for name in fetch_names}
                 lo = 0
                 probe_size = fast_chunk if fast_chunk > chunk else None
+                from ..utils.chaos import site as _chaos_site
+
                 while lo < n:
                     hi = min(lo + fast_chunk, n)
                     _m_blocks_map_rows.inc()
                     feed = {ph: feeders[ph](lo, hi) for ph in binding}
                     try:
+                        # chaos here exercises the degrade path: a
+                        # non-OOM failure drops the whole pass to the
+                        # synchronous chunked engine (retry + halving)
+                        _chaos_site("engine.dispatch")
                         res = run_bucket(feed, hi - lo)
                         # the raised-chunk OOM probe syncs so halving can
                         # react before the rest of the pass dispatches —
@@ -1476,6 +1490,9 @@ def _reduce_blocks_impl(fetches, dframe: TensorFrame):
             def dispatch(_feed=feed):
                 import jax
 
+                from ..utils.chaos import site as _chaos_site
+
+                _chaos_site("engine.dispatch")
                 return jax.block_until_ready(jit_fn(_feed))
 
             partials.append(
@@ -1488,6 +1505,9 @@ def _reduce_blocks_impl(fetches, dframe: TensorFrame):
         def all_partials() -> List[Dict[str, Any]]:
             import jax
 
+            from ..utils.chaos import site as _chaos_site
+
+            _chaos_site("engine.dispatch")
             ps = [
                 jit_fn(feed)
                 for feed in map(feed_for, range(dframe.num_partitions))

@@ -58,6 +58,7 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from ..obs import span as _span
+from ..utils import chaos as _chaos
 from ..obs.metrics import (
     counter as _counter,
     enabled as _obs_enabled,
@@ -295,12 +296,17 @@ class ScoringServer:
         - ``GET /metrics`` — the default registry in Prometheus
           exposition format, so ``curl http://host:port/metrics`` (or an
           actual scrape job) works against a live server with no sidecar;
+        - ``GET /healthz`` — liveness JSON (engine watchdog age, queue
+          depth, pages in use); 200 while healthy, 503 once the serving
+          supervisor marked the engine unhealthy or a stop wedged;
         - ``POST /generate`` (``engine=`` configured) — JSON
           ``{"prompt": [ids], "max_new_tokens": n, "temperature"?,
-          "top_p"?, "seed"?}`` submitted to the continuous-batching
-          engine; responds ``{"request_id", "tokens"}`` when the stream
-          completes. 503 on a full admission queue (backpressure), 400 on
-          an infeasible request.
+          "top_p"?, "seed"?, "deadline_s"?}`` submitted to the
+          continuous-batching engine; responds ``{"request_id",
+          "tokens"}`` when the stream completes. 503 + ``Retry-After``
+          on a full admission queue or an unhealthy engine (shed, don't
+          block), 504 on a missed deadline, 400 on an infeasible
+          request.
 
         Returns the request kind for the metrics label."""
         import json
@@ -333,49 +339,82 @@ class ScoringServer:
 
         kind = "metrics"
         ctype = "text/plain; charset=utf-8"
+        extra_headers: Dict[str, str] = {}
         if verb == "GET" and path in ("/metrics", "/metrics/"):
             out = _render_prometheus().encode("utf-8")
             status = "200 OK"
             ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif verb == "GET" and path in ("/healthz", "/healthz/"):
+            kind = "healthz"
+            status, out = self._handle_healthz()
+            ctype = "application/json; charset=utf-8"
         elif verb == "POST" and path == "/generate":
             kind = "generate"
-            status, out = self._handle_generate(body)
+            status, out, extra_headers = self._handle_generate(body)
             ctype = "application/json; charset=utf-8"
         else:
-            out = b"endpoints: GET /metrics, POST /generate\n"
+            out = b"endpoints: GET /metrics, GET /healthz, POST /generate\n"
             status = "404 Not Found"
+        header_lines = "".join(
+            f"{k}: {v}\r\n" for k, v in extra_headers.items()
+        )
         conn.sendall(
             (
                 f"HTTP/1.1 {status}\r\n"
                 f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(out)}\r\n"
+                f"{header_lines}"
                 "Connection: close\r\n\r\n"
             ).encode("latin-1")
             + out
         )
         return kind
 
-    def _handle_generate(self, body: bytes) -> Tuple[str, bytes]:
+    def _handle_healthz(self) -> Tuple[str, bytes]:
+        """Liveness for load balancers and the chaos soak: the engine's
+        :meth:`~tensorframes_tpu.serve.GenerationEngine.health` snapshot
+        (last-step watchdog age, queue depth, pages in use, unhealthy
+        flags). A server with no engine is just an Arrow scorer — always
+        healthy as long as it accepts connections."""
+        import json
+
+        if self._engine is None:
+            report: Dict[str, Any] = {"healthy": True, "engine": None}
+        else:
+            report = self._engine.health()
+        status = "200 OK" if report["healthy"] else "503 Service Unavailable"
+        return status, json.dumps(report).encode("utf-8")
+
+    def _handle_generate(
+        self, body: bytes
+    ) -> Tuple[str, bytes, Dict[str, str]]:
         """One generate request against the engine; returns (status,
-        JSON body). Failure modes map to HTTP semantics instead of
-        crashing the connection thread: bad JSON / infeasible request →
-        400, no engine → 501, full admission queue → 503."""
+        JSON body, extra headers). Failure modes map to HTTP semantics
+        instead of crashing the connection thread: bad JSON / infeasible
+        request → 400, no engine → 501, full admission queue or
+        unhealthy engine → fast 503 with ``Retry-After`` (shedding, not
+        blocking), missed deadline (``"deadline_s"`` in the request, or
+        the ``serve_result_timeout_s`` backstop) → 504."""
         import json
 
         if self._engine is None:
             return "501 Not Implemented", json.dumps(
                 {"error": "server has no generation engine"}
-            ).encode("utf-8")
+            ).encode("utf-8"), {}
+        from ..serve.engine import EngineUnhealthyError
         from ..serve.scheduler import QueueFullError
+        from ..utils.config import get_config
 
         try:
             spec = json.loads(body.decode("utf-8") or "{}")
             prompt = spec["prompt"]
             max_new = int(spec["max_new_tokens"])
+            deadline = spec.get("deadline_s")
+            deadline = None if deadline is None else float(deadline)
         except (ValueError, KeyError, TypeError) as e:
             return "400 Bad Request", json.dumps(
                 {"error": f"bad request: {type(e).__name__}: {e}"}
-            ).encode("utf-8")
+            ).encode("utf-8"), {}
         try:
             handle = self._engine.submit(
                 prompt,
@@ -383,35 +422,43 @@ class ScoringServer:
                 temperature=float(spec.get("temperature", 0.0)),
                 top_p=float(spec.get("top_p", 1.0)),
                 seed=int(spec.get("seed", 0)),
+                deadline=deadline,
                 block=False,
             )
-        except QueueFullError as e:
+        except (QueueFullError, EngineUnhealthyError) as e:
+            # overload shedding: the caller can retry, THIS server can't
+            # help right now — answer fast instead of parking the
+            # connection against a full queue or a dead engine
             return "503 Service Unavailable", json.dumps(
                 {"error": str(e)}
-            ).encode("utf-8")
+            ).encode("utf-8"), {"Retry-After": "1"}
         except ValueError as e:
             return "400 Bad Request", json.dumps(
                 {"error": str(e)}
-            ).encode("utf-8")
+            ).encode("utf-8"), {}
         try:
-            toks = handle.result(timeout=300)
+            toks = handle.result(
+                timeout=get_config().serve_result_timeout_s
+            )
         except TimeoutError as e:
+            # DeadlineExceededError (the scheduler evicted it) and the
+            # result-timeout backstop both mean the same thing upstream
             return "504 Gateway Timeout", json.dumps(
                 {"request_id": handle.request_id, "error": str(e)}
-            ).encode("utf-8")
+            ).encode("utf-8"), {}
         except Exception as e:  # engine-side failure closed the handle
             return "500 Internal Server Error", json.dumps(
                 {
                     "request_id": handle.request_id,
                     "error": f"{type(e).__name__}: {e}",
                 }
-            ).encode("utf-8")
+            ).encode("utf-8"), {}
         return "200 OK", json.dumps(
             {
                 "request_id": handle.request_id,
                 "tokens": [int(t) for t in toks],
             }
-        ).encode("utf-8")
+        ).encode("utf-8"), {}
 
     def _serve_one(self, conn: socket.socket) -> None:
         import pyarrow as pa
@@ -427,6 +474,9 @@ class ScoringServer:
             _m_active.adjust(1.0)
         try:
             with conn:
+                # chaos: a dropped/slow connection at the door — the
+                # teardown path below must absorb it like a real one
+                _chaos.site("serving.conn")
                 first = self._peek(conn)
                 if not first:
                     # client connected and went away without a request

@@ -11,11 +11,12 @@ for the whole lifetime. See ``docs/serving_llm.md``.
 - :mod:`.engine` — the compiled prefill/decode steps + streaming API
 """
 
-from .engine import GenerationEngine
+from .engine import EngineUnhealthyError, GenerationEngine
 from .kv_pages import PagePool, SequencePages, pages_needed
 from .scheduler import GenerationHandle, GenRequest, QueueFullError, Scheduler
 
 __all__ = [
+    "EngineUnhealthyError",
     "GenerationEngine",
     "GenerationHandle",
     "GenRequest",

@@ -28,13 +28,26 @@ exhaustion); each :meth:`submit` returns a
 steps complete. Observability: queue depth / batch occupancy /
 pages-in-use gauges, time-to-first-token and inter-token latency
 histograms, all on the PR-1 registry (``docs/observability.md``).
+
+**Supervision** (``docs/fault_tolerance.md``): step failures are
+classified against the ``utils/failures.py`` taxonomy — transient
+dispatch errors retry with bounded backoff inside the step, device OOM
+recovers by ``defragment()`` + preempt-youngest (recompute-style, so
+streams never replay or lose tokens), and anything fatal fails every
+in-flight handle promptly with the real error and marks the engine
+unhealthy (``submit`` sheds with :class:`EngineUnhealthyError`;
+``GET /healthz`` reports it). :meth:`restart` rebuilds device state
+from host-side scheduler progress — emitted bytes stay identical and
+no step program recompiles. Per-request deadlines
+(``submit(deadline=...)``) are swept every step; expired requests fail
+with :class:`~tensorframes_tpu.utils.failures.DeadlineExceededError`.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -50,6 +63,14 @@ from ..obs.metrics import (
     gauge as _gauge,
     histogram as _histogram,
 )
+from ..utils import chaos as _chaos
+from ..utils.config import get_config
+from ..utils.failures import (
+    DeadlineExceededError,
+    is_oom,
+    is_transient,
+    run_with_retries,
+)
 from ..utils.logging import get_logger
 from .kv_pages import PagePool, pages_needed
 from .scheduler import (
@@ -60,7 +81,7 @@ from .scheduler import (
     _Active,
 )
 
-__all__ = ["GenerationEngine"]
+__all__ = ["EngineUnhealthyError", "GenerationEngine"]
 
 logger = get_logger("serve.engine")
 
@@ -93,6 +114,39 @@ _m_requests = _counter(
     "Generation requests by terminal status",
     labels=("status",),
 )
+_m_restarts = _counter(
+    "serve.engine_restarts_total",
+    "GenerationEngine.restart() recoveries (device state rebuilt from "
+    "host-side scheduler progress)",
+)
+_m_deadline_expired = _counter(
+    "serve.deadline_expired_total",
+    "Requests evicted because their deadline passed (queued or "
+    "mid-generation)",
+)
+_m_handles_failed = _counter(
+    "serve.handles_failed_total",
+    "Generation handles closed with an error, by classified reason",
+    labels=("reason",),
+)
+
+
+class EngineUnhealthyError(RuntimeError):
+    """The engine is shedding load: a terminal stepping failure (or a
+    wedged stop) marked it unhealthy, and submissions fail fast until
+    :meth:`GenerationEngine.restart`. The HTTP endpoint maps this to
+    503 + ``Retry-After`` (``interop/serving.py``)."""
+
+
+def _fail_reason(e: BaseException) -> str:
+    """Bounded reason label for ``serve.handles_failed_total``."""
+    if isinstance(e, DeadlineExceededError):
+        return "deadline"
+    if is_oom(e):
+        return "oom"
+    if is_transient(e):
+        return "transient_exhausted"
+    return "fatal"
 
 
 class GenerationEngine:
@@ -181,6 +235,17 @@ class GenerationEngine:
         self._step_lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        #: False after a terminal stepping failure (supervisor fail-fast)
+        #: or a wedged stop; submit sheds until restart()
+        self.healthy = True
+        #: stop() observed the stepping thread outliving its join window
+        self._stop_wedged = False
+        #: consecutive decode steps lost to device OOM — bounds the
+        #: defragment + preempt-youngest recovery loop
+        self._consecutive_ooms = 0
+        #: monotonic time the last step COMPLETED (the /healthz watchdog:
+        #: a large age with work queued means the stepping path is wedged)
+        self._last_step_t = time.monotonic()
         _m_pages_capacity.set(float(num_pages))
 
     # -- compiled step builders -------------------------------------------
@@ -293,11 +358,17 @@ class GenerationEngine:
         eos_id: Optional[int] = None,
         block: bool = True,
         timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
     ) -> GenerationHandle:
         """Queue one generation request; returns its streaming handle.
-        Raises ``ValueError`` for requests that could never be scheduled
-        and :class:`~.scheduler.QueueFullError` when the bounded queue is
-        full and ``block=False``."""
+        Raises ``ValueError`` for requests that could never be scheduled,
+        :class:`~.scheduler.QueueFullError` when the bounded queue is
+        full and ``block=False``, and :class:`EngineUnhealthyError` when
+        the engine is shedding after a terminal failure (restart() to
+        recover). ``deadline`` is a per-request budget in SECONDS from
+        now: the step sweep evicts the request — queued or
+        mid-generation — once it passes, and the handle raises
+        :class:`~tensorframes_tpu.utils.failures.DeadlineExceededError`."""
         prompt = np.asarray(prompt, np.int32).ravel()
         if prompt.size < 1:
             _m_requests.inc(status="rejected")
@@ -306,6 +377,20 @@ class GenerationEngine:
             _m_requests.inc(status="rejected")
             raise ValueError(
                 f"max_new_tokens must be >= 1; got {max_new_tokens}"
+            )
+        if deadline is not None and deadline <= 0:
+            _m_requests.inc(status="rejected")
+            raise ValueError(
+                f"deadline must be positive seconds from now; got {deadline}"
+            )
+        if not self.healthy or self._stop_wedged:
+            # shed instead of queueing work a broken engine will never
+            # run — the caller gets the fast 503, not a hung handle
+            _m_requests.inc(status="rejected")
+            raise EngineUnhealthyError(
+                "engine is unhealthy after a terminal stepping failure "
+                "or a wedged stop; restart() it (or recycle the process) "
+                "before submitting"
             )
         with self._submit_lock:
             self._req_counter += 1
@@ -320,6 +405,9 @@ class GenerationEngine:
             seed=int(seed),
             eos_id=self.eos_id if eos_id is None else eos_id,
             handle=handle,
+            deadline_t=(
+                None if deadline is None else time.monotonic() + deadline
+            ),
         )
         try:
             self.scheduler.submit(req, block=block, timeout=timeout)
@@ -335,52 +423,123 @@ class GenerationEngine:
     # -- stepping ----------------------------------------------------------
 
     def step(self) -> bool:
-        """One scheduler iteration: admit + prefill newcomers, grow pages
-        (preempting on exhaustion), one decode step for the batch.
-        Returns whether work remains. Exceptions from the device fail the
-        affected requests' handles and re-raise."""
+        """One scheduler iteration: sweep expired deadlines, admit +
+        prefill newcomers, grow pages (preempting on exhaustion), one
+        decode step for the batch. Returns whether work remains.
+
+        Failure classification (the supervisor's contract,
+        ``docs/fault_tolerance.md``): transient dispatch errors retry
+        with bounded backoff INSIDE the step (``run_with_retries`` on
+        the compiled-step calls); device OOM mid-decode recovers by
+        ``defragment()`` + preempt-youngest without failing anyone;
+        whatever still escapes fails the affected requests' handles and
+        re-raises for the caller (the background loop then fails the
+        rest and marks the engine unhealthy)."""
         with self._step_lock:
-            prefill_err: Optional[BaseException] = None
-            for idx, act in self.scheduler.admit():
-                try:
-                    self._prefill_one(idx, act)
-                except Exception as e:
-                    # fail THIS request only and keep admitting: aborting
-                    # mid-loop would leave later-admitted slots with no
-                    # prefill (empty ``generated``), poisoning the next
-                    # decode batch
-                    self.scheduler.finish(idx, error=e)
-                    _m_requests.inc(status="failed")
-                    if prefill_err is None:
-                        prefill_err = e
-            if prefill_err is not None:
-                # every surviving slot is prefilled; propagate now, before
-                # decode, so synchronous drivers see the device error
-                self._refresh_gauges()
-                raise prefill_err
-            batch = self.scheduler.active
-            if batch:
-                ready: List[Tuple[int, _Active]] = []
-                for idx, act in batch:
-                    if self.scheduler.slots[idx] is not act:
-                        continue  # preempted as a victim already
-                    if self.scheduler.grow(idx):
-                        ready.append((idx, act))
-                # growth for a later slot may have evicted an earlier one
-                ready = [
-                    (i, a) for i, a in ready if self.scheduler.slots[i] is a
-                ]
-                if ready:
-                    try:
-                        self._decode_batch(ready)
-                    except Exception as e:
-                        for i, _ in ready:
-                            if self.scheduler.slots[i] is not None:
-                                self.scheduler.finish(i, error=e)
-                                _m_requests.inc(status="failed")
-                        raise
+            try:
+                return self._step_locked()
+            finally:
+                # the /healthz watchdog: age of the last step COMPLETION
+                # (normal, recovered, or failed — a wedged device call is
+                # the thing this must expose, and that never reaches here)
+                self._last_step_t = time.monotonic()
+
+    def _step_locked(self) -> bool:
+        expired = self.scheduler.expire(time.monotonic())
+        if expired:
+            _m_deadline_expired.inc(expired)
+            _m_handles_failed.inc(expired, reason="deadline")
+            _m_requests.inc(expired, status="failed")
+        prefill_err: Optional[BaseException] = None
+        for idx, act in self.scheduler.admit():
+            try:
+                self._prefill_one(idx, act)
+            except Exception as e:
+                if is_oom(e) and self._note_oom():
+                    # device OOM on a prefill gets the same degrade the
+                    # decode path gets, not a terminal failure: nothing
+                    # was emitted yet, so the request requeues
+                    # recompute-style (a preempt of itself) after
+                    # compacting, and the next step retries it
+                    logger.warning(
+                        "prefill hit device OOM (%d consecutive); "
+                        "defragmenting and requeueing request %d",
+                        self._consecutive_ooms,
+                        act.req.request_id,
+                    )
+                    self.pool.defragment(
+                        [a.seq for _, a in self.scheduler.active]
+                    )
+                    self.scheduler.preempt(idx)
+                    continue
+                # fail THIS request only and keep admitting: aborting
+                # mid-loop would leave later-admitted slots with no
+                # prefill (empty ``generated``), poisoning the next
+                # decode batch
+                self.scheduler.finish(idx, error=e)
+                _m_requests.inc(status="failed")
+                _m_handles_failed.inc(reason=_fail_reason(e))
+                if prefill_err is None:
+                    prefill_err = e
+        if prefill_err is not None:
+            # every surviving slot is prefilled; propagate now, before
+            # decode, so synchronous drivers see the device error
             self._refresh_gauges()
-            return self.scheduler.has_work()
+            raise prefill_err
+        batch = self.scheduler.active
+        if batch:
+            ready: List[Tuple[int, _Active]] = []
+            for idx, act in batch:
+                if self.scheduler.slots[idx] is not act:
+                    continue  # preempted as a victim already
+                if self.scheduler.grow(idx):
+                    ready.append((idx, act))
+            # growth for a later slot may have evicted an earlier one
+            ready = [
+                (i, a) for i, a in ready if self.scheduler.slots[i] is a
+            ]
+            if ready:
+                try:
+                    self._decode_batch(ready)
+                    self._consecutive_ooms = 0
+                except Exception as e:
+                    if is_oom(e) and self._recover_oom():
+                        self._refresh_gauges()
+                        return True
+                    for i, _ in ready:
+                        if self.scheduler.slots[i] is not None:
+                            self.scheduler.finish(i, error=e)
+                            _m_requests.inc(status="failed")
+                            _m_handles_failed.inc(reason=_fail_reason(e))
+                    raise
+        self._refresh_gauges()
+        return self.scheduler.has_work()
+
+    def _note_oom(self) -> bool:
+        """One more consecutive OOM recovery attempt; False once the
+        bounded budget (``max_slots + 1`` without a completed decode) is
+        spent — shrinking cannot help, treat the OOM as fatal."""
+        self._consecutive_ooms += 1
+        return self._consecutive_ooms <= self.max_slots + 1
+
+    def _recover_oom(self) -> bool:
+        """Device OOM mid-decode: the batch died BEFORE its emission loop
+        (no tokens were streamed), so the step is safe to redo. Compact
+        the pool and shed the youngest sequence (recompute-style requeue
+        — its stream never notices), then let the next step retry with a
+        smaller batch. Bounded via :meth:`_note_oom`."""
+        if not self._note_oom():
+            return False
+        logger.warning(
+            "decode step hit device OOM (%d consecutive); defragmenting "
+            "and preempting the youngest sequence",
+            self._consecutive_ooms,
+        )
+        self.pool.defragment([a.seq for _, a in self.scheduler.active])
+        victim = self.scheduler._youngest_active(exclude=-1)
+        if victim is not None:
+            self.scheduler.preempt(victim)
+        return True
 
     def _prefill_one(self, idx: int, act: _Active) -> None:
         req = act.req
@@ -398,9 +557,25 @@ class GenerationEngine:
         )
         pool = self.pool
         self._record_program("prefill", self._params_dev, pool.k, *args)
+
+        # dispatch inside a retry window, SYNCED inside it (jax dispatch
+        # is async; failures.py's coverage rule): the compiled call is
+        # functional and pool arrays are reassigned only on success, so a
+        # transient failure retries with an identical result. On TPU the
+        # step donates pool.k/v — a mid-execution failure there consumes
+        # the donated buffers, the retry fails non-transiently, and the
+        # supervisor escalates to fail-fast + restart() instead.
+        def dispatch():
+            import jax
+
+            _chaos.site("serve.prefill")
+            return jax.block_until_ready(
+                self._prefill_jit(self._params_dev, pool.k, pool.v, *args)
+            )
+
         with _span("serve.prefill", request=req.request_id, prompt_len=plen):
-            pool.k, pool.v, tok = self._prefill_jit(
-                self._params_dev, pool.k, pool.v, *args
+            pool.k, pool.v, tok = run_with_retries(
+                dispatch, what=f"serve.prefill request {req.request_id}"
             )
         self._emit(idx, act, int(tok))
 
@@ -424,9 +599,21 @@ class GenerationEngine:
         args = (toks, positions, ptabs, temps, seeds, top_ps)
         pool = self.pool
         self._record_program("decode", self._params_dev, pool.k, *args)
+
+        # synced inside the retry window, like prefill (the host loop
+        # needs ``nxt`` before the next step anyway, so the sync costs
+        # no pipelining); same donation caveat as prefill on TPU
+        def dispatch():
+            import jax
+
+            _chaos.site("serve.decode_step")
+            return jax.block_until_ready(
+                self._decode_jit(self._params_dev, pool.k, pool.v, *args)
+            )
+
         with _span("serve.decode_step", occupancy=len(ready)):
-            pool.k, pool.v, nxt = self._decode_jit(
-                self._params_dev, pool.k, pool.v, *args
+            pool.k, pool.v, nxt = run_with_retries(
+                dispatch, what="serve.decode_step"
             )
         nxt = np.asarray(nxt)
         for idx, act in ready:
@@ -470,6 +657,83 @@ class GenerationEngine:
                 [a.seq for _, a in self.scheduler.active]
             )
 
+    # -- supervision -------------------------------------------------------
+
+    def _fail_inflight(self, error: BaseException) -> None:
+        """The fail-fast path: close EVERY in-flight handle (active slots
+        and the whole admission queue) with the real error, NOW, and mark
+        the engine unhealthy until :meth:`restart`. A consumer must see
+        a doomed stream's failure within a step — never hang to its
+        timeout against an engine that will not produce another token."""
+        self.healthy = False
+        reason = _fail_reason(error)
+        with self._step_lock:
+            n = self.scheduler.fail_all(error)
+        if n:
+            _m_requests.inc(n, status="failed")
+            _m_handles_failed.inc(n, reason=reason)
+        self._refresh_gauges()
+
+    def restart(self) -> "GenerationEngine":
+        """Rebuild device state from host-side scheduler progress after a
+        crash (lost pool arrays, a fatal step error). Every active
+        sequence is preempted — its progress folds into its prompt, so
+        re-admission re-prefills prompt + emitted tokens and the stream's
+        emitted bytes stay identical — the page pool is re-zeroed, and
+        the engine is marked healthy again. The compiled step programs
+        survive (every shape is unchanged), so recovery adds zero
+        recompiles: ``num_step_programs`` stays <= 2."""
+        if self._stop_wedged:
+            # the old stepping thread never exited; flipping healthy here
+            # would accept work nothing can step (start() still refuses
+            # while _thread is set). stop() again to retry the join.
+            raise RuntimeError(
+                "cannot restart a wedged engine: the stepping thread "
+                "never exited its stop join — stop() again to retry, or "
+                "recycle the process"
+            )
+        with self._step_lock:
+            # youngest-first so the OLDEST request ends up at the queue
+            # front (each preempt requeues at the front) — re-admission
+            # preserves the oldest-first service order
+            for idx, _ in reversed(self.scheduler.active):
+                self.scheduler.preempt(idx)
+            self.pool.reset()
+            self._consecutive_ooms = 0
+            self.healthy = True
+            self._last_step_t = time.monotonic()
+        _m_restarts.inc()
+        with self.scheduler._lock:
+            self.scheduler._lock.notify_all()  # wake the stepping thread
+        logger.warning(
+            "engine restarted: device state rebuilt, %d request(s) "
+            "requeued for recompute",
+            self.scheduler.queue_depth,
+        )
+        return self
+
+    def health(self) -> Dict[str, object]:
+        """Liveness snapshot for ``GET /healthz``: the last-step watchdog
+        age, queue/batch/pool occupancy, and the unhealthy flags the
+        supervisor and :meth:`stop` raise."""
+        thread = self._thread
+        return {
+            "healthy": bool(self.healthy and not self._stop_wedged),
+            "last_step_age_s": round(
+                time.monotonic() - self._last_step_t, 3
+            ),
+            "queue_depth": self.scheduler.queue_depth,
+            "active_slots": sum(
+                s is not None for s in self.scheduler.slots
+            ),
+            "pages_in_use": self.pool.pages_in_use,
+            "pages_capacity": self.pool.num_pages,
+            "stepping_thread_alive": (
+                thread.is_alive() if thread is not None else None
+            ),
+            "stop_wedged": self._stop_wedged,
+        }
+
     # -- background serving ------------------------------------------------
 
     def start(self) -> "GenerationEngine":
@@ -478,24 +742,46 @@ class GenerationEngine:
         if self._thread is not None:
             raise RuntimeError("engine already started")
         self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._supervised_loop, daemon=True
+        )
+        self._thread.start()
+        return self
 
-        def loop():
+    def _supervised_loop(self) -> None:
+        """The serving loop under supervision. Recoverable failures never
+        reach here (transient retries and OOM recovery live inside
+        :meth:`step`); whatever does escape is terminal for the in-flight
+        work, so every handle is failed promptly with the real error and
+        the engine flips unhealthy (submit sheds, ``/healthz`` goes red)
+        until :meth:`restart`. The loop itself keeps running either way —
+        it never dies silently with streams still attached."""
+        try:
             while not self._stop.is_set():
                 try:
                     worked = self.step()
-                except Exception:
-                    logger.warning(
-                        "generation step failed", exc_info=True
+                except Exception as e:
+                    # split, not splitlines: str(e) may be empty (bare
+                    # asserts), and "".splitlines()[0] would kill the
+                    # loop this handler exists to keep alive
+                    logger.error(
+                        "generation step failed terminally (%s); failing "
+                        "all in-flight requests and marking the engine "
+                        "unhealthy — restart() to recover",
+                        f"{type(e).__name__}: "
+                        + str(e).split("\n", 1)[0][:200],
                     )
-                    worked = True  # the failed batch was cleared; go on
+                    self._fail_inflight(e)
+                    worked = False
                 if not worked:
                     with self.scheduler._lock:
                         if not self.scheduler._waiting:
                             self.scheduler._lock.wait(0.02)
-
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
-        return self
+        except BaseException as e:  # the supervisor must never die silently
+            if not self._stop.is_set():
+                logger.error("stepping thread died", exc_info=True)
+                self._fail_inflight(e)
+            raise
 
     def stop(self) -> None:
         if self._thread is None:
@@ -504,7 +790,34 @@ class GenerationEngine:
         with self.scheduler._lock:
             self.scheduler._lock.notify_all()
         self._thread.join(timeout=10)
+        if self._thread.is_alive():
+            # pretending the stop worked would hand the caller a zombie
+            # stepping thread; surface it loudly, shed new work, and keep
+            # the thread reference so a later stop() can retry the join
+            logger.warning(
+                "stepping thread did not stop within 10s (wedged device "
+                "call?); engine marked unhealthy — stop() again to retry"
+            )
+            self._stop_wedged = True
+            self.healthy = False
+            return
+        self._stop_wedged = False
         self._thread = None
+        # anything still in flight will never get another step: fail the
+        # handles now instead of stranding their consumers
+        with self._step_lock:
+            n = self.scheduler.fail_all(
+                RuntimeError("engine stopped with the request in flight")
+            )
+        if n:
+            _m_requests.inc(n, status="failed")
+            _m_handles_failed.inc(n, reason="shutdown")
+            logger.warning(
+                "engine stopped with %d request(s) in flight; their "
+                "handles were failed",
+                n,
+            )
+            self._refresh_gauges()
 
     def __enter__(self) -> "GenerationEngine":
         return self.start()
@@ -526,4 +839,5 @@ class GenerationEngine:
         handles = [self.submit(p, max_new_tokens, **kw) for p in prompts]
         if self._thread is None:
             self.run_until_idle()
-        return [h.result(timeout=300) for h in handles]
+        timeout = get_config().serve_result_timeout_s
+        return [h.result(timeout=timeout) for h in handles]

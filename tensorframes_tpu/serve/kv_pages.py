@@ -31,6 +31,7 @@ from typing import Dict, Iterable, List, Sequence
 
 import numpy as np
 
+from ..utils import chaos as _chaos
 from ..utils.failures import PagePoolExhausted
 
 __all__ = ["PagePool", "SequencePages", "pages_needed"]
@@ -100,6 +101,7 @@ class PagePool:
         """Take ``n`` pages off the free list — all or nothing (a partial
         grant would leak pages when the caller unwinds). Raises
         :class:`PagePoolExhausted` when fewer than ``n`` are free."""
+        _chaos.site("kv_pages.alloc")
         with self._lock:
             if n > len(self._free):
                 raise PagePoolExhausted(
@@ -130,6 +132,29 @@ class PagePool:
     @property
     def pages_in_use(self) -> int:
         return self.num_pages - self.pages_free
+
+    def reset(self) -> None:
+        """Crash recovery: discard ALL device state and bookkeeping —
+        fresh zeroed page arrays, every page back on the free list. The
+        caller (:meth:`GenerationEngine.restart`) must first requeue
+        every live sequence (their KV contents are rebuilt from
+        host-side progress by re-prefill); any :class:`SequencePages`
+        still holding pages after this call is stale."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            shape = (
+                self.n_layers,
+                self.num_pages + 1,
+                self.page_size,
+                self.n_kv_heads,
+                self.head_dim,
+            )
+            dtype = self.k.dtype
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
+            self._free = list(range(self.num_pages - 1, -1, -1))
+            self._free_set = set(self._free)
 
     # -- defragmentation ---------------------------------------------------
 

@@ -34,7 +34,11 @@ from typing import Deque, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils.failures import PagePoolExhausted, record_preemption
+from ..utils.failures import (
+    DeadlineExceededError,
+    PagePoolExhausted,
+    record_preemption,
+)
 from .kv_pages import PagePool, SequencePages, pages_needed
 
 __all__ = [
@@ -120,6 +124,10 @@ class GenRequest:
     handle: GenerationHandle = None  # type: ignore[assignment]
     submitted_at: float = field(default_factory=time.monotonic)
     emitted: int = 0  # tokens already streamed (pre-preemption progress)
+    #: absolute ``time.monotonic()`` deadline, or None for no deadline;
+    #: the engine's step sweep evicts expired requests (queued OR
+    #: mid-generation) with :class:`DeadlineExceededError`
+    deadline_t: Optional[float] = None
 
 
 class _Active:
@@ -329,6 +337,7 @@ class Scheduler:
             handle=req.handle,
             submitted_at=req.submitted_at,
             emitted=req.emitted + len(act.generated),
+            deadline_t=req.deadline_t,
         )
         record_preemption("serve")
         self._requeue_front(new_req)
@@ -341,3 +350,69 @@ class Scheduler:
         act.seq.release()
         self.slots[idx] = None
         act.req.handle._finish(error)
+
+    # -- supervision -------------------------------------------------------
+
+    def expire(self, now: float) -> int:
+        """Evict every request whose deadline has passed: queued requests
+        are failed in place (their handle raises
+        :class:`DeadlineExceededError`), active ones release their slot
+        and pages too. Returns the number evicted. Called from the
+        engine's step sweep, so an expired request is gone within one
+        step — it never occupies a slot the live traffic needs."""
+        expired: List[GenRequest] = []
+        with self._lock:
+            if self._waiting:
+                keep: Deque[GenRequest] = deque()
+                for r in self._waiting:
+                    if r.deadline_t is not None and now >= r.deadline_t:
+                        expired.append(r)
+                    else:
+                        keep.append(r)
+                if expired:
+                    self._waiting = keep
+                    self._lock.notify_all()  # queue shrank: wake submitters
+        for r in expired:
+            r.handle._finish(
+                DeadlineExceededError(
+                    f"request {r.request_id} exceeded its deadline while "
+                    f"queued for admission"
+                )
+            )
+        n = len(expired)
+        for i, a in enumerate(self.slots):
+            if (
+                a is not None
+                and a.req.deadline_t is not None
+                and now >= a.req.deadline_t
+            ):
+                self.finish(
+                    i,
+                    error=DeadlineExceededError(
+                        f"request {a.req.request_id} exceeded its deadline "
+                        f"mid-generation ({len(a.generated)} of "
+                        f"{a.req.max_new_tokens} tokens emitted)"
+                    ),
+                )
+                n += 1
+        return n
+
+    def fail_all(self, error: BaseException) -> int:
+        """Terminal sweep: fail EVERY in-flight request — active slots
+        and the whole admission queue — with ``error``, releasing their
+        pages. Returns how many handles were closed. The supervisor's
+        fail-fast path: a consumer must see a doomed engine's real error
+        within a step, not hang to its timeout."""
+        n = 0
+        for i, a in enumerate(self.slots):
+            if a is not None:
+                self.finish(i, error=error)
+                n += 1
+        with self._lock:
+            drained = list(self._waiting)
+            self._waiting.clear()
+            if drained:
+                self._lock.notify_all()
+        for r in drained:
+            r.handle._finish(error)
+        return n + len(drained)

@@ -8,7 +8,14 @@ from .config import (
     enable_compilation_cache,
 )
 from .logging import get_logger
-from .failures import DeviceOOMError, is_oom, is_transient, run_with_retries
+from .failures import (
+    DeadlineExceededError,
+    DeviceOOMError,
+    is_oom,
+    is_transient,
+    run_with_retries,
+)
+from . import chaos
 from . import profiling
 
 __all__ = [
@@ -18,9 +25,11 @@ __all__ = [
     "ensure_x64",
     "enable_compilation_cache",
     "get_logger",
+    "DeadlineExceededError",
     "DeviceOOMError",
     "is_oom",
     "is_transient",
     "run_with_retries",
+    "chaos",
     "profiling",
 ]

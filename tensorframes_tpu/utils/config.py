@@ -52,6 +52,20 @@ class Config:
     #: span a no-op. ``TFT_OBS=0`` in the environment forces the same off
     #: state regardless of this field (read once at import).
     observability: bool = True
+    #: how long synchronous consumers of a generation handle wait before
+    #: declaring the stream lost: ``GenerationEngine.generate`` and the
+    #: HTTP ``POST /generate`` endpoint both call
+    #: ``handle.result(timeout=this)``. With the serving supervisor a
+    #: doomed stream is failed within a step, so this is a last-resort
+    #: backstop, not the primary failure path (docs/serving_llm.md).
+    serve_result_timeout_s: float = 300.0
+    #: fault-injection (chaos) schedule spec, e.g.
+    #: ``"seed=7;serve.decode_step=transient:p=0.2;kv_pages.alloc=pool:every=9"``.
+    #: Empty (the default) disables every injection site down to a single
+    #: module-global check; the ``TFT_CHAOS`` environment variable
+    #: supplies the spec when this field is empty. Grammar and site list:
+    #: ``utils/chaos.py`` and docs/fault_tolerance.md.
+    chaos: str = ""
 
 
 _lock = threading.Lock()

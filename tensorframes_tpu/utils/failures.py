@@ -30,8 +30,9 @@ Everything here is policy-free mechanics; knobs live in
 
 from __future__ import annotations
 
+import re
 import time
-from typing import Callable, TypeVar
+from typing import Callable, Iterator, TypeVar
 
 from .logging import get_logger
 
@@ -41,6 +42,7 @@ __all__ = [
     "run_with_retries",
     "record_oom_split",
     "record_preemption",
+    "DeadlineExceededError",
     "DeviceOOMError",
     "PagePoolExhausted",
 ]
@@ -91,23 +93,47 @@ def record_preemption(op: str) -> None:
 T = TypeVar("T")
 
 #: status substrings that mark a dispatch worth retrying (PJRT surfaces
-#: grpc-style statuses in the exception text)
+#: grpc-style statuses in the exception text). Matching is
+#: case-insensitive — PJRT renders ``UNAVAILABLE``, grpc-python
+#: ``unavailable``, wrappers anything in between — so every marker is
+#: stored lowercase and compared against lowered exception text.
 _TRANSIENT_MARKERS = (
-    "UNAVAILABLE",
-    "DEADLINE_EXCEEDED",
-    "ABORTED",
+    "unavailable",
+    "deadline_exceeded",
+    "aborted",
     "connection reset",
-    "Connection reset",
-    "Socket closed",
     "socket closed",
 )
 
 _OOM_MARKERS = (
-    "RESOURCE_EXHAUSTED",
-    "Out of memory",
+    "resource_exhausted",
     "out of memory",
-    "OOM",
 )
+
+#: "OOM" must match as a WORD: plain substring matching (the old
+#: behavior) classified "zoom"/"room"/"Bloom filter" messages as device
+#: OOMs once matching went case-insensitive
+_OOM_WORD = re.compile(r"\boom\b")
+
+
+def _exc_chain(e: BaseException) -> Iterator[BaseException]:
+    """``e`` and its explicit causes (``raise X from Y``), cycle-safe.
+    PJRT statuses often arrive wrapped — a retry decision must see
+    through ``RuntimeError("dispatch failed") from <UNAVAILABLE>``.
+    Implicit ``__context__`` links are deliberately NOT followed: an
+    unrelated error raised while handling a transient one must not
+    inherit its retryability."""
+    seen = set()
+    cur: "BaseException | None" = e
+    while cur is not None and id(cur) not in seen and len(seen) < 8:
+        seen.add(id(cur))
+        yield cur
+        cur = cur.__cause__
+
+
+def _exc_text(e: BaseException) -> str:
+    """Lowered text of the whole cause chain, for marker matching."""
+    return "\n".join(str(x) for x in _exc_chain(e)).lower()
 
 
 class DeviceOOMError(RuntimeError):
@@ -123,27 +149,35 @@ class PagePoolExhausted(DeviceOOMError):
     crashing the batch (see :mod:`tensorframes_tpu.serve.scheduler`)."""
 
 
+class DeadlineExceededError(TimeoutError):
+    """A generation request outlived its caller-supplied deadline and was
+    evicted by the serving scheduler (queued or mid-generation). A
+    terminal, caller-facing condition — never retried (the deadline has
+    already passed) and deliberately NOT classified transient, unlike a
+    PJRT ``DEADLINE_EXCEEDED`` dispatch status, which marks a retryable
+    device call. HTTP maps it to 504 (``interop/serving.py``)."""
+
+
 def is_oom(e: BaseException) -> bool:
-    if isinstance(e, DeviceOOMError):
+    if any(isinstance(x, DeviceOOMError) for x in _exc_chain(e)):
         return True
-    s = str(e)
-    return any(m in s for m in _OOM_MARKERS)
+    s = _exc_text(e)
+    return any(m in s for m in _OOM_MARKERS) or _OOM_WORD.search(s) is not None
 
 
 def is_transient(e: BaseException) -> bool:
-    if is_oom(e):
+    if isinstance(e, DeadlineExceededError) or is_oom(e):
         return False
-    s = str(e)
+    s = _exc_text(e)
     return any(m in s for m in _TRANSIENT_MARKERS)
 
 
 def _failure_reason(e: BaseException) -> str:
     """Short label for a classified failure: the matched status marker
     (normalized), or the exception type when no marker matched."""
-    s = str(e)
-    for m in _OOM_MARKERS:
-        if m in s:
-            return "OOM"
+    if is_oom(e):
+        return "OOM"
+    s = _exc_text(e)
     for m in _TRANSIENT_MARKERS:
         if m in s:
             return m.upper().replace(" ", "_")
@@ -176,10 +210,12 @@ def run_with_retries(fn: Callable[[], T], what: str = "device dispatch") -> T:
             delay = cfg.retry_backoff_s * (2.0 ** attempt)
             attempt += 1
             _retries_total.inc(op=_op_label(what), reason=_failure_reason(e))
+            # split, not splitlines: an exception classified off its CAUSE
+            # chain can have an empty str(e), and "".splitlines() is []
             logger.warning(
                 "%s failed with a transient error (%s); retry %d/%d in %.1fs",
                 what,
-                str(e).splitlines()[0][:200],
+                str(e).split("\n", 1)[0][:200],
                 attempt,
                 cfg.max_retries,
                 delay,

@@ -43,6 +43,76 @@ class TestClassification:
         # OOM is NOT transient: identical retry cannot help
         assert not is_transient(RuntimeError("RESOURCE_EXHAUSTED"))
 
+    def test_markers_match_case_insensitively(self):
+        # PJRT renders UNAVAILABLE, grpc-python unavailable, wrappers
+        # anything between — the casing must not decide retryability
+        assert is_transient(RuntimeError("unavailable: tunnel dropped"))
+        assert is_transient(RuntimeError("Deadline_Exceeded: rpc wait"))
+        assert is_transient(RuntimeError("Connection Reset by peer"))
+        assert is_transient(RuntimeError("SOCKET CLOSED mid-write"))
+        assert is_oom(RuntimeError("resource_exhausted: hbm"))
+        assert is_oom(RuntimeError("OUT OF MEMORY while allocating"))
+        assert is_oom(RuntimeError("oom during reduction"))
+
+    def test_chained_cause_text_is_seen(self):
+        # a wrapped PJRT status (`raise X from Y`) keeps its class
+        def build(inner_msg, outer_msg="dispatch failed"):
+            try:
+                try:
+                    raise RuntimeError(inner_msg)
+                except RuntimeError as inner:
+                    raise RuntimeError(outer_msg) from inner
+            except RuntimeError as outer:
+                return outer
+
+        assert is_transient(build("UNAVAILABLE: preempted tunnel"))
+        assert is_oom(build("RESOURCE_EXHAUSTED: hbm"))
+        assert not is_transient(build("RESOURCE_EXHAUSTED: hbm"))
+        assert not is_transient(build("just a bug"))
+        # implicit __context__ (no `from`) must NOT leak retryability:
+        # an unrelated error raised while HANDLING a transient one is
+        # its own failure
+        try:
+            try:
+                raise RuntimeError("UNAVAILABLE: flaky")
+            except RuntimeError:
+                raise ValueError("bug in the handler")
+        except ValueError as e:
+            assert not is_transient(e)
+
+    def test_typed_oom_anywhere_in_chain(self):
+        try:
+            try:
+                raise DeviceOOMError("pool dry")
+            except DeviceOOMError as inner:
+                raise RuntimeError("step failed") from inner
+        except RuntimeError as e:
+            assert is_oom(e) and not is_transient(e)
+
+    def test_near_miss_strings_do_not_match(self):
+        # "oom" must match as a word, not as a substring of zoom/room —
+        # the old any-substring matching would break here once markers
+        # went case-insensitive
+        assert not is_oom(RuntimeError("zoom level 3 unsupported"))
+        assert not is_oom(RuntimeError("the room is full"))
+        assert not is_oom(RuntimeError("Bloom filter saturated"))
+        assert is_oom(RuntimeError("OOM: killed"))
+        assert is_oom(RuntimeError("device oom (16G requested)"))
+        # "not available" is not "unavailable"
+        assert not is_transient(RuntimeError("backend not available"))
+        # a deadline that was merely mentioned is not the status marker
+        assert not is_transient(RuntimeError("the deadline exceeded plan"))
+
+    def test_deadline_exceeded_error_is_terminal(self):
+        from tensorframes_tpu.utils import DeadlineExceededError
+
+        e = DeadlineExceededError("request 7 exceeded its deadline")
+        # a missed REQUEST deadline is caller-facing and final — unlike
+        # a PJRT DEADLINE_EXCEEDED dispatch status, which retries
+        assert not is_transient(e)
+        assert not is_oom(e)
+        assert isinstance(e, TimeoutError)
+
 
 class TestRunWithRetries:
     def test_retries_then_succeeds(self, fast_retries):

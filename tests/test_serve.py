@@ -9,6 +9,7 @@ multiplexing are pure memory-layout concerns, invisible in the streams.
 import json
 import socket
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -16,6 +17,7 @@ import pytest
 from tensorframes_tpu.models import TransformerLM
 from tensorframes_tpu.obs import metrics as obs_metrics
 from tensorframes_tpu.serve import (
+    EngineUnhealthyError,
     GenerationEngine,
     GenRequest,
     GenerationHandle,
@@ -25,7 +27,19 @@ from tensorframes_tpu.serve import (
     SequencePages,
     pages_needed,
 )
-from tensorframes_tpu.utils.failures import PagePoolExhausted
+from tensorframes_tpu.utils import chaos, get_config, set_config
+from tensorframes_tpu.utils.failures import (
+    DeadlineExceededError,
+    PagePoolExhausted,
+)
+
+
+@pytest.fixture
+def fast_retries():
+    old = (get_config().max_retries, get_config().retry_backoff_s)
+    set_config(max_retries=2, retry_backoff_s=0.001)
+    yield
+    set_config(max_retries=old[0], retry_backoff_s=old[1])
 
 pytestmark = pytest.mark.serve
 
@@ -310,6 +324,231 @@ class TestPreemption:
         assert eng.num_step_programs <= 2  # preemption did not recompile
 
 
+class TestSupervisor:
+    def test_fatal_step_failure_fails_all_handles_fast(self, lm):
+        """REGRESSION: a stepping-thread exception must fail every
+        in-flight handle within a second — queued ones included — not
+        strand them until the result timeout (the pre-fix behavior hung
+        the full 300 s)."""
+        from tensorframes_tpu.utils.chaos import ChaosFault
+
+        rng = np.random.default_rng(20)
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        prompts = _prompts(rng, (3, 4, 2, 5))  # 2 active + 2 queued
+        with chaos.scoped("serve.decode_step=fatal:times=1"):
+            with eng:
+                handles = [eng.submit(p, 6) for p in prompts]
+                # wait out compile + the injected failure on the first one
+                with pytest.raises(ChaosFault):
+                    handles[0].result(timeout=30)
+                # every other handle must already be (or instantly be) dead
+                t0 = time.monotonic()
+                for h in handles[1:]:
+                    with pytest.raises(ChaosFault):
+                        h.result(timeout=1)
+                assert time.monotonic() - t0 < 1.0
+                assert not eng.healthy
+                # unhealthy engine sheds instead of queueing doomed work
+                with pytest.raises(EngineUnhealthyError):
+                    eng.submit(prompts[0], 4)
+                assert _counter_value(
+                    "serve.handles_failed_total", reason="fatal"
+                ) >= 4
+
+    def test_transient_step_failures_retry_invisibly(self, lm, fast_retries):
+        rng = np.random.default_rng(21)
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        prompts = _prompts(rng, (4, 3))
+        before = _counter_value(
+            "chaos.injections_total", site="serve.decode_step",
+            kind="transient",
+        )
+        with chaos.scoped("seed=5;serve.decode_step=transient:every=3"):
+            outs = eng.generate(prompts, max_new_tokens=6)
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(o, _solo(lm, p, 6))
+        assert eng.healthy
+        assert _counter_value(
+            "chaos.injections_total", site="serve.decode_step",
+            kind="transient",
+        ) > before
+        assert eng.num_step_programs <= 2
+
+    def test_decode_oom_recovers_by_defrag_and_preempt(
+        self, lm, fast_retries
+    ):
+        rng = np.random.default_rng(22)
+        eng = GenerationEngine(lm, max_slots=3, page_size=4, max_seq_len=32)
+        prompts = _prompts(rng, (4, 6, 3))
+        before = _counter_value("failures.preemptions_total", op="serve")
+        with chaos.scoped("serve.decode_step=oom:every=4:times=2"):
+            outs = eng.generate(prompts, max_new_tokens=8)
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(o, _solo(lm, p, 8))
+        assert eng.healthy  # OOM was degraded through, not fatal
+        assert _counter_value("failures.preemptions_total", op="serve") > before
+        assert eng.num_step_programs <= 2
+
+    def test_prefill_oom_requeues_recompute_style(self, lm, fast_retries):
+        """A device OOM during prefill degrades like a decode OOM does —
+        the request (nothing emitted yet) requeues for a retry — instead
+        of escalating to a fail-everything terminal error."""
+        rng = np.random.default_rng(25)
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        prompts = _prompts(rng, (4, 3))
+        with chaos.scoped("serve.prefill=oom:every=1:times=1"):
+            outs = eng.generate(prompts, max_new_tokens=6)
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(o, _solo(lm, p, 6))
+        assert eng.healthy
+        assert eng.pool.pages_in_use == 0
+
+    def test_empty_message_exception_does_not_kill_the_loop(self, lm):
+        """str(e) == "" (bare asserts and friends) must not crash the
+        supervisor's own logging: handles still fail with the real
+        error and the loop thread survives."""
+        rng = np.random.default_rng(26)
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        with eng:
+
+            def boom(ready):
+                raise RuntimeError()
+
+            eng._decode_batch = boom
+            h = eng.submit(_prompts(rng, (3,))[0], 4)
+            with pytest.raises(RuntimeError):
+                h.result(timeout=30)
+            assert eng._thread.is_alive()  # the supervisor survived
+            # the handle fails inside step(); the unhealthy flip happens
+            # a beat later in the supervisor — give it that beat
+            for _ in range(200):
+                if not eng.healthy:
+                    break
+                time.sleep(0.01)
+            assert not eng.healthy
+
+    def test_restart_rebuilds_device_state_mid_run(self, lm):
+        """Crash recovery: device KV state is corrupted mid-run; restart()
+        preempts every live sequence (progress folded into prompts),
+        re-zeroes the pool, and the streams stay byte-identical — with
+        zero new compiled programs."""
+        rng = np.random.default_rng(23)
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        prompts = _prompts(rng, (5, 3))
+        handles = [eng.submit(p, 8) for p in prompts]
+        for _ in range(3):
+            eng.step()
+        before = _counter_value("serve.engine_restarts_total")
+        eng.pool.k = eng.pool.k * 0.0 + 7.25  # simulated device loss
+        eng.pool.v = eng.pool.v * 0.0 - 3.5
+        eng.restart()
+        eng.run_until_idle()
+        for p, h in zip(prompts, handles):
+            np.testing.assert_array_equal(h.result(timeout=1), _solo(lm, p, 8))
+        assert _counter_value("serve.engine_restarts_total") == before + 1
+        assert eng.num_step_programs <= 2
+        assert eng.pool.pages_in_use == 0
+
+    def test_stop_join_failure_flips_unhealthy(self, lm):
+        """stop() must not pretend a wedged stepping thread stopped: it
+        flags the engine unhealthy and keeps the thread for a retry."""
+
+        class _WedgedThread:
+            def join(self, timeout=None):
+                pass
+
+            def is_alive(self):
+                return True
+
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        eng.start()
+        real = eng._thread
+        eng._thread = _WedgedThread()
+        eng.stop()
+        assert eng._stop_wedged and not eng.healthy
+        h = eng.health()
+        assert h["healthy"] is False and h["stop_wedged"] is True
+        # a wedged engine must refuse work AND refuse a restart that
+        # could not actually step (the old thread still owns the loop)
+        with pytest.raises(EngineUnhealthyError):
+            eng.submit([1, 2], 2)
+        with pytest.raises(RuntimeError, match="wedged"):
+            eng.restart()
+        # the retry path: the real thread exits on the stop event
+        eng._thread = real
+        eng.stop()
+        assert eng._thread is None and not eng._stop_wedged
+        eng.restart()
+        assert eng.health()["healthy"] is True
+
+
+class TestDeadlines:
+    def test_queued_request_expires(self, lm):
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        before = _counter_value("serve.deadline_expired_total")
+        h = eng.submit([1, 2, 3], 4, deadline=0.01)
+        time.sleep(0.05)
+        eng.step()
+        assert h.done and isinstance(h.error, DeadlineExceededError)
+        with pytest.raises(DeadlineExceededError):
+            h.result(timeout=1)
+        assert _counter_value("serve.deadline_expired_total") == before + 1
+        assert _counter_value(
+            "serve.handles_failed_total", reason="deadline"
+        ) >= 1
+
+    def test_mid_generation_deadline_releases_slot_and_pages(self, lm):
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=48)
+        h = eng.submit([1, 2, 3, 4], 40, deadline=0.05)
+        eng.step()  # admit + prefill + first decode
+        assert not h.done
+        time.sleep(0.06)
+        eng.step()  # expiry sweep evicts the running sequence
+        assert h.done and isinstance(h.error, DeadlineExceededError)
+        assert eng.pool.pages_in_use == 0
+        assert all(s is None for s in eng.scheduler.slots)
+
+    def test_deadline_must_be_positive(self, lm):
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        with pytest.raises(ValueError, match="deadline"):
+            eng.submit([1, 2], 4, deadline=0.0)
+
+
+class TestAdmissionPressure:
+    def test_submit_timeout_races_queue_drain(self, lm):
+        """A blocked submit(timeout=) must win the race when the stepping
+        side drains the queue before the timeout — and lose it cleanly
+        (QueueFullError, request not enqueued) when nothing drains."""
+        rng = np.random.default_rng(24)
+        eng = GenerationEngine(
+            lm, max_slots=1, page_size=4, max_seq_len=32, queue_capacity=1
+        )
+        p1, p2 = _prompts(rng, (3, 4))
+        h1 = eng.submit(p1, 5)  # fills the capacity-1 queue
+        # no drain: the timed submit must give up on time
+        t0 = time.monotonic()
+        with pytest.raises(QueueFullError):
+            eng.submit(p2, 5, timeout=0.05)
+        assert time.monotonic() - t0 < 5
+        # racing drain: stepping empties the queue while submit waits
+        # (the admission pop notifies submitters immediately — the win
+        # happens mid-step, before the drain thread's step returns)
+        def drain():
+            time.sleep(0.15)
+            eng.step()  # admits h1 -> queue has room
+
+        t = threading.Thread(target=drain)
+        t.start()
+        t1 = time.monotonic()
+        h2 = eng.submit(p2, 5, timeout=30)  # parks, then wins the race
+        waited = time.monotonic() - t1
+        assert 0.14 <= waited < 30, waited  # parked until the drain ran
+        t.join()
+        eng.run_until_idle()
+        np.testing.assert_array_equal(h1.result(timeout=1), _solo(lm, p1, 5))
+        np.testing.assert_array_equal(h2.result(timeout=1), _solo(lm, p2, 5))
+
+
 @pytest.mark.slow
 class TestSoak:
     def test_sixteen_staggered_requests_byte_identical(self, lm):
@@ -449,6 +688,84 @@ class TestGenerateEndpoint:
                 addr, {"prompt": [1, 2], "max_new_tokens": 2}
             )
             assert status == 503
+
+    def test_healthz_reports_engine_state(self, lm):
+        from tensorframes_tpu.interop.serving import ScoringServer
+
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        with ScoringServer(engine=eng) as addr:
+            resp = _http(addr, b"GET /healthz HTTP/1.1\r\n\r\n")
+            status = int(resp.split(b" ", 2)[1])
+            body = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+            assert status == 200 and body["healthy"] is True
+            for key in (
+                "last_step_age_s",
+                "queue_depth",
+                "active_slots",
+                "pages_in_use",
+                "pages_capacity",
+                "stepping_thread_alive",
+                "stop_wedged",
+            ):
+                assert key in body, key
+            assert body["stepping_thread_alive"] is True
+            # the supervisor flipping unhealthy turns the probe red
+            eng.healthy = False
+            resp = _http(addr, b"GET /healthz HTTP/1.1\r\n\r\n")
+            assert int(resp.split(b" ", 2)[1]) == 503
+            eng.healthy = True
+
+    def test_healthz_without_engine_is_healthy(self):
+        from tensorframes_tpu.interop.serving import ScoringServer
+
+        with ScoringServer(lambda x: {"y": x}) as addr:
+            resp = _http(addr, b"GET /healthz HTTP/1.1\r\n\r\n")
+            assert int(resp.split(b" ", 2)[1]) == 200
+            body = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+            assert body == {"healthy": True, "engine": None}
+
+    def test_shedding_answers_503_with_retry_after(self, lm):
+        from tensorframes_tpu.interop.serving import ScoringServer
+
+        eng = GenerationEngine(
+            lm, max_slots=2, page_size=4, max_seq_len=16, queue_capacity=0
+        )
+        with ScoringServer(engine=eng) as addr:
+            # full admission queue: fast 503, caller told when to retry
+            resp = _http(
+                addr,
+                b"POST /generate HTTP/1.1\r\nContent-Length: 40\r\n\r\n"
+                b'{"prompt": [1, 2], "max_new_tokens": 2}\n',
+            )
+            assert int(resp.split(b" ", 2)[1]) == 503
+            assert b"Retry-After: 1" in resp
+            # unhealthy engine: same shedding, not a hang
+            eng.healthy = False
+            status, payload = _post_generate(
+                addr, {"prompt": [1, 2], "max_new_tokens": 2}
+            )
+            assert status == 503 and "unhealthy" in payload["error"]
+            eng.healthy = True
+
+    def test_deadline_s_maps_to_504(self, lm):
+        from tensorframes_tpu.interop.serving import ScoringServer
+
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=48)
+        # slow every decode step down so a 150 ms budget cannot fit the
+        # requested 40 tokens — the sweep evicts mid-generation
+        with chaos.scoped("serve.decode_step=latency:ms=60"):
+            with ScoringServer(engine=eng) as addr:
+                status, payload = _post_generate(
+                    addr,
+                    {
+                        "prompt": [1, 2, 3],
+                        "max_new_tokens": 40,
+                        "deadline_s": 0.15,
+                    },
+                )
+        assert status == 504
+        assert "deadline" in payload["error"].lower()
+        assert eng.pool.pages_in_use == 0
 
     def test_generate_only_server_refuses_arrow_scoring(self, lm):
         from tensorframes_tpu.interop.serving import (
