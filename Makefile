@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-chaos test-multihost verify bench bench-serve bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-chaos test-durability test-multihost verify bench bench-serve bench-jobs bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -40,6 +40,11 @@ verify:
 test-chaos:
 	$(PY) -m pytest tests/ -q -m chaos
 
+# the durable batch-job suite (engine/jobs.py: journal, crash-resume,
+# quarantine) — fast, CPU-only, deterministic; part of tier-1
+test-durability:
+	$(PY) -m pytest tests/ -q -m durability
+
 # just the real 2-process distributed suite
 test-multihost:
 	$(PY) -m pytest tests/test_multihost.py -q
@@ -51,6 +56,10 @@ bench:
 # serving trajectory: tokens/s + inter-token latency at 1/4/16 concurrency
 bench-serve:
 	$(PY) bench.py decode_serve
+
+# durable-job overhead: map_rows with the journal on vs off (one JSON line)
+bench-jobs:
+	$(PY) bench.py map_rows
 
 # all BASELINE configs + extras
 bench-all:

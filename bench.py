@@ -32,6 +32,13 @@ batching generation engine (``tensorframes_tpu/serve``): tokens/sec and
 p50/p99 INTER-TOKEN latency at 1, 4 and 16 concurrent requests — the
 serving trajectory the ROADMAP's heavy-traffic target is measured by.
 Also exactly one JSON line.
+
+``python bench.py map_rows`` benchmarks the durable batch-job layer
+(``tensorframes_tpu/engine/jobs.py``): the same ``map_rows`` job with
+the journal **on** vs **off** (identical block loop; the delta is the
+npz spooling + ledger appends on the background journal thread),
+reporting rows/s for both and the journaling overhead percentage.
+Also exactly one JSON line.
 """
 
 import json
@@ -376,10 +383,107 @@ def main_decode_serve():
     )
 
 
+def main_map_rows_journal():
+    """Durable-job overhead: one ``map_rows`` workload through
+    ``run_job`` with the journal off (in-memory ledger: the same
+    deterministic block loop, zero disk I/O) and on (npz spool +
+    buffered ledger append per block). The ratio isolates what
+    journaling itself costs; the acceptance bar is ≤ 5%.
+
+    The workload is a two-layer MLP scored per row — the reference's
+    flagship pattern (frozen model, per-row scoring) at a realistic
+    compute weight, journaled at 32k-row block granularity. Both knobs
+    matter for what this bench claims: the journal costs ~1 ms per
+    block flat (one npz spool + one buffered append; on a single-core
+    host the background writer cannot truly overlap compute, so that
+    cost is real), so the overhead *ratio* is a statement about jobs
+    whose resume units carry real work. A job with sub-millisecond
+    blocks finishes in milliseconds and has no business paying for
+    durability; conversely, coarser blocks mean fewer resume points —
+    the granularity knob is ``Config.max_rows_per_device_call``."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.engine import run_job
+    from tensorframes_tpu.utils import get_config, set_config
+
+    tft.enable_compilation_cache()
+    n_rows, width = 500_000, 256
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, width)).astype(np.float32)
+    df = tft.TensorFrame.from_columns({"features": x}).analyze()
+
+    import jax.numpy as jnp
+
+    w1 = jnp.asarray(rng.normal(size=(width, width)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(size=(width,)).astype(np.float32))
+
+    def score(features):
+        return {"s": jnp.tanh(features @ w1) @ w2}
+
+    job_root = tempfile.mkdtemp(prefix="tft-bench-jobs-")
+    iters = 8
+    old_chunk = get_config().max_rows_per_device_call
+    set_config(max_rows_per_device_call=32768)
+
+    def one(journal: bool, i: int) -> float:
+        t0 = time.perf_counter()
+        res = run_job(
+            "map_rows", score, df, journal=journal,
+            job_dir=job_root, job_id=f"bench-{journal}-{i}",
+        )
+        dt = time.perf_counter() - t0
+        assert res.completed.num_rows == n_rows
+        one.blocks = res.blocks_total
+        return dt
+
+    # warmup both variants (compile + page cache), then INTERLEAVE the
+    # timed runs so fs/scheduler drift hits both modes equally; best-of
+    # is the noise-robust statistic for a fixed workload
+    one(False, -1), one(True, -2)
+    dt_off = dt_on = float("inf")
+    for i in range(iters):
+        dt_off = min(dt_off, one(False, i))
+        dt_on = min(dt_on, one(True, i + iters))
+    blocks = one.blocks
+    set_config(max_rows_per_device_call=old_chunk)
+    shutil.rmtree(job_root, ignore_errors=True)
+    overhead_pct = (dt_on - dt_off) / dt_off * 100.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "map_rows_journaled_rows_per_sec",
+                "value": round(n_rows / dt_on, 1),
+                "unit": "rows/s",
+                "detail": {
+                    "workload": (
+                        f"map_rows MLP-score job ({width}x{width} tanh MLP), "
+                        f"{n_rows} x {width} f32, {blocks} journal blocks"
+                    ),
+                    "device": str(jax.devices()[0]),
+                    "journal_off_rows_per_sec": round(n_rows / dt_off, 1),
+                    "journal_on_rows_per_sec": round(n_rows / dt_on, 1),
+                    "journal_overhead_pct": round(overhead_pct, 2),
+                    "seconds_per_job": {
+                        "journal_off": round(dt_off, 4),
+                        "journal_on": round(dt_on, 4),
+                    },
+                },
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
     import sys
 
     if len(sys.argv) > 1 and sys.argv[1] == "decode_serve":
         main_decode_serve()
+    elif len(sys.argv) > 1 and sys.argv[1] == "map_rows":
+        main_map_rows_journal()
     else:
         main()

@@ -47,6 +47,11 @@ from .engine import (
     explain,
     block,
     row,
+    run_job,
+    resume_job,
+    JobResult,
+    QuarantinedBlock,
+    load_quarantine,
     InputNotFoundError,
     InvalidTypeError,
     InvalidDimensionError,
@@ -83,6 +88,12 @@ __all__ = [
     "print_schema",
     "block",
     "row",
+    # durable batch jobs (engine/jobs.py)
+    "run_job",
+    "resume_job",
+    "JobResult",
+    "QuarantinedBlock",
+    "load_quarantine",
     # frames & schema
     "Shape",
     "Unknown",

@@ -19,8 +19,22 @@ from .validation import (
     InvalidDimensionError,
     OutputCollisionError,
 )
+from .jobs import (
+    BlockLedger,
+    JobResult,
+    QuarantinedBlock,
+    load_quarantine,
+    resume_job,
+    run_job,
+)
 
 __all__ = [
+    "BlockLedger",
+    "JobResult",
+    "QuarantinedBlock",
+    "load_quarantine",
+    "resume_job",
+    "run_job",
     "map_blocks",
     "precompile",
     "map_rows",

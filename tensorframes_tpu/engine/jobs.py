@@ -1,0 +1,984 @@
+"""Durable batch jobs: block-level journaling, crash-resume, quarantine.
+
+The reference never solved batch fault tolerance itself — it rode Spark's
+lineage-based task retry and executor blacklisting (SURVEY §5), and this
+reproduction dropped that layer with Spark. ``utils/failures.py`` covers
+*in-process* faults (transient retry, OOM degrade), but a process crash
+still lost the whole job, and one deterministically-failing block killed
+everything around it. This module is the missing durability layer:
+
+- **journal**: a job is an engine op (``map_rows`` / ``map_blocks`` /
+  ``reduce_blocks`` / ``aggregate``) executed against a
+  :class:`BlockLedger`. The ledger writes a small on-disk *manifest*
+  (job id, op, graph/schema fingerprint, row count, the block plan) and,
+  as each block completes, spools its results (npz) and appends a
+  completion record to an append-only ``ledger.jsonl`` — npz first
+  (atomic rename), record second, so a crash at any instant leaves a
+  readable journal. Durability model: every completed ``write()``
+  survives *process death* (the threat the journal exists for — a
+  kill-9'd job resumes losing only blocks whose records had not landed,
+  which recompute); against a whole-OS crash the fsync'd manifest and
+  final completion marker survive and any torn tail (unparseable
+  ledger line, unreadable npz) is detected on resume and simply
+  recomputes. Per-block fsyncs are deliberately NOT issued, and block
+  records are written by a background journal thread so a block's disk
+  I/O overlaps the next block's compute (the decode-prefetch idiom) —
+  both are what keeps journaling inside the ≤ 5% overhead budget while
+  buying nothing less against process death.
+- **crash-resume**: :func:`resume_job` replays the journal and re-runs
+  the op; blocks with completion records are *restored* from their
+  spools and only unfinished blocks recompute. The block plan is
+  deterministic (partition bounds / fixed row chunks in a fixed bucket
+  order), so a resumed job's output is byte-identical to a clean run.
+- **quarantine**: a block whose program fails *deterministically*
+  (non-transient, non-OOM after retries — the Spark-blacklisting
+  analogue) is recorded with the real error in ``quarantine.json``,
+  skipped, and the job continues. The partial result surfaces as
+  ``JobResult.completed`` + ``JobResult.quarantined``; strict mode
+  raises :class:`~tensorframes_tpu.utils.failures.QuarantinedBlocksError`
+  at job end instead (healthy blocks are still journaled first).
+  Transient and OOM failures are *never* quarantined — they are
+  capacity/infrastructure conditions: the job fails and resumes later.
+
+Journal layout (``<job_dir>/<job_id>/``)::
+
+    manifest.json            job id, op, fingerprint, row count, block plan
+    blocks/block-00007.npz   spooled fetch arrays for block 7
+    ledger.jsonl             append-only completion / quarantine / event log
+    quarantine.json          current quarantined blocks with their errors
+
+Chaos sites ``jobs.block`` (per-block execution — a ``fatal`` kind is
+the poison-block drill) and ``jobs.journal_write`` (the spool+append
+path — a ``fatal`` there simulates a crash between computing a block
+and recording it) drive the whole subsystem under the deterministic
+harness; see docs/fault_tolerance.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import span as _span
+from ..obs.metrics import counter as _counter
+from ..utils import get_logger
+from ..utils.failures import (
+    QuarantinedBlocksError,
+    is_oom,
+    is_transient,
+    run_with_retries,
+)
+
+__all__ = [
+    "BlockLedger",
+    "JobResult",
+    "QuarantinedBlock",
+    "jobs_status",
+    "load_quarantine",
+    "resume_job",
+    "run_job",
+]
+
+logger = get_logger("jobs")
+
+_m_blocks = _counter(
+    "jobs.blocks_total",
+    "Batch-job blocks by terminal status (computed fresh, restored from "
+    "the journal, quarantined)",
+    labels=("status",),
+)
+_m_resumes = _counter(
+    "jobs.resumes_total", "Batch jobs resumed from an on-disk journal"
+)
+_m_quarantined = _counter(
+    "jobs.quarantined_total", "Blocks quarantined across all batch jobs"
+)
+
+_OPS = ("map_rows", "map_blocks", "reduce_blocks", "aggregate")
+
+_MANIFEST = "manifest.json"
+_LEDGER = "ledger.jsonl"
+_QUARANTINE = "quarantine.json"
+_BLOCK_DIR = "blocks"
+#: spooled-array key prefix inside a block npz (keeps fetch names out of
+#: np.savez's own parameter namespace — a fetch named "file" is legal)
+_SPOOL_PREFIX = "c_"
+
+
+def _default_job_dir() -> str:
+    return os.environ.get("TFT_JOB_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "tensorframes_tpu", "jobs"
+    )
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+@dataclasses.dataclass
+class QuarantinedBlock:
+    """One poisoned block: its plan position and the real error."""
+
+    index: int
+    rows: Optional[int]
+    error_type: str
+    error: str
+    traceback: str = ""
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "QuarantinedBlock":
+        return cls(
+            index=int(d["index"]),
+            rows=d.get("rows"),
+            error_type=d.get("error_type", ""),
+            error=d.get("error", ""),
+            traceback=d.get("traceback", ""),
+        )
+
+
+@dataclasses.dataclass
+class JobResult:
+    """Outcome of a (possibly partial) batch job.
+
+    ``completed`` is the op's result built from every non-quarantined
+    block — a :class:`~tensorframes_tpu.frame.TensorFrame` for the maps
+    and ``aggregate``, the reduce value for ``reduce_blocks`` (``None``
+    when every block quarantined). ``quarantined`` lists the poisoned
+    blocks with their real errors; call :meth:`raise_if_quarantined` (or
+    run strict) to turn a partial result into an exception."""
+
+    job_id: str
+    op: str
+    path: Optional[str]
+    completed: Any
+    quarantined: List[QuarantinedBlock]
+    resumed: bool
+    blocks_total: int
+    blocks_computed: int
+    blocks_restored: int
+
+    def raise_if_quarantined(self) -> "JobResult":
+        if self.quarantined:
+            raise QuarantinedBlocksError(
+                _quarantine_message(self.job_id, self.quarantined),
+                self.quarantined,
+            )
+        return self
+
+
+def _quarantine_message(job_id: str, blocks: List[QuarantinedBlock]) -> str:
+    head = ", ".join(
+        f"block {b.index} ({b.error_type}: {b.error.splitlines()[0][:120] if b.error else ''})"
+        for b in blocks[:3]
+    )
+    more = f" (+{len(blocks) - 3} more)" if len(blocks) > 3 else ""
+    return (
+        f"job {job_id}: {len(blocks)} block(s) quarantined after "
+        f"deterministic failures: {head}{more}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+# ---------------------------------------------------------------------------
+
+
+class BlockLedger:
+    """Per-job journal + per-block completion/quarantine bookkeeping.
+
+    The engine's block loops (``engine/ops.py``) drive it with three
+    calls: :meth:`ensure_plan` once (write on a fresh job, validate on
+    resume), then per block :meth:`lookup` (restored / quarantined /
+    todo) and :meth:`run_block` (execute, classify failures, spool).
+    ``path=None`` is the in-memory mode: the same block loop and
+    quarantine semantics with zero disk I/O (overhead baselines, tests,
+    ``run_job(journal=False)``)."""
+
+    def __init__(self, path: Optional[str], job_id: str, op: str):
+        if op not in _OPS:
+            raise ValueError(f"unknown job op {op!r}; expected one of {_OPS}")
+        self.path = path
+        self.job_id = job_id
+        self.op = op
+        self._plan: Optional[List[Dict[str, Any]]] = None
+        self._manifest: Optional[Dict[str, Any]] = None
+        #: block index -> spool relpath (disk, lazily loaded) or the
+        #: result arrays themselves (memory mode / after load)
+        self._done: Dict[int, Any] = {}
+        self._quar: Dict[int, QuarantinedBlock] = {}
+        self._restored = 0
+        self._computed = 0
+        self._complete = False
+        self._ledger_file = None
+        #: background journal writer: block i's spool overlaps block
+        #: i+1's compute (the decode-prefetch idiom); errors park in
+        #: _writer_error and surface at the next block / finalize
+        self._write_q = None
+        self._writer: Optional[threading.Thread] = None
+        self._writer_error: Optional[BaseException] = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def create(
+        cls, path: Optional[str], job_id: str, op: str
+    ) -> "BlockLedger":
+        """A fresh ledger. With a path, the journal directory is created
+        (and must not already hold a manifest — jobs never silently
+        overwrite each other's journals)."""
+        led = cls(path, job_id, op)
+        if path is not None:
+            os.makedirs(os.path.join(path, _BLOCK_DIR), exist_ok=True)
+            if os.path.exists(os.path.join(path, _MANIFEST)):
+                raise ValueError(
+                    f"journal directory {path!r} already holds a job "
+                    f"manifest; use resume_job() to continue it or pick "
+                    f"a fresh job_id"
+                )
+        return led
+
+    @classmethod
+    def open_(cls, path: str) -> "BlockLedger":
+        """Load an existing journal for resume. Torn tail lines in
+        ``ledger.jsonl`` (a crash mid-append) are ignored; a completion
+        record whose npz spool is missing or unreadable is dropped and
+        its block recomputes."""
+        with open(os.path.join(path, _MANIFEST), "rb") as f:
+            manifest = json.loads(f.read().decode("utf-8"))
+        led = cls(path, manifest["job_id"], manifest["op"])
+        led._manifest = manifest
+        led._plan = manifest["plan"]
+        try:
+            with open(os.path.join(path, _LEDGER), "rb") as f:
+                lines = f.read().decode("utf-8", "replace").splitlines()
+        except FileNotFoundError:
+            lines = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break  # torn tail write; everything before it is valid
+            if rec.get("event") == "complete":
+                led._complete = True
+            elif rec.get("event") == "quarantine_cleared":
+                led._quar.clear()
+            elif rec.get("status") == "done":
+                spool = os.path.join(path, rec["npz"])
+                if os.path.exists(spool):
+                    led._done[int(rec["block"])] = rec["npz"]
+                else:
+                    logger.warning(
+                        "job %s: block %s has a completion record but no "
+                        "spool at %s; it will recompute",
+                        led.job_id, rec.get("block"), rec["npz"],
+                    )
+            elif rec.get("status") == "quarantined":
+                led._quar[int(rec["block"])] = QuarantinedBlock.from_dict(rec)
+        return led
+
+    # -- plan --------------------------------------------------------------
+
+    @staticmethod
+    def _fingerprint(
+        op: str,
+        graph,
+        schema,
+        rows: int,
+        extra: Optional[Dict[str, Any]],
+    ) -> str:
+        """Structural job fingerprint: op, placeholder specs, fetch
+        names, input schema, row count. It validates that a resume is
+        re-running *the same job shape*; program bytes are not hashed —
+        supplying a different computation with an identical signature is
+        the caller's contract, same as Spark's assumption that a re-run
+        closure matches its lineage."""
+        import hashlib
+
+        payload: Dict[str, Any] = {
+            "op": op,
+            "rows": int(rows),
+            "extra": extra or {},
+        }
+        if graph is not None:
+            payload["fetches"] = list(graph.fetch_names)
+            payload["placeholders"] = sorted(
+                (
+                    name,
+                    spec.scalar_type.name,
+                    [str(d) for d in spec.shape.dims],
+                )
+                for name, spec in graph.placeholders.items()
+            )
+        if schema is not None:
+            payload["schema"] = [
+                [c.name, c.scalar_type.name] for c in schema
+            ]
+        blob = json.dumps(payload, sort_keys=True).encode("utf-8")
+        return hashlib.sha256(blob).hexdigest()
+
+    def ensure_plan(
+        self,
+        entries: List[Dict[str, Any]],
+        *,
+        graph=None,
+        schema=None,
+        rows: int = 0,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Install the block plan. Fresh job: write the manifest.
+        Resume: validate the recomputed plan + fingerprint against the
+        journal — a mismatched frame, program signature, or chunking
+        must fail loudly, not silently splice wrong spools into the
+        output."""
+        entries = json.loads(json.dumps(entries))  # normalize numerics
+        fp = self._fingerprint(self.op, graph, schema, rows, extra)
+        if self._manifest is not None:
+            if self._manifest.get("fingerprint") != fp:
+                raise ValueError(
+                    f"journal at {self.path!r} was written for a "
+                    f"different job (op/program/schema/row-count "
+                    f"fingerprint mismatch); resume_job must be given "
+                    f"the same fetches and input frame"
+                )
+            if self._manifest.get("plan") != entries:
+                raise ValueError(
+                    f"journal at {self.path!r} holds a different block "
+                    f"plan ({len(self._manifest.get('plan', []))} blocks "
+                    f"vs {len(entries)} now); the frame's partitioning/"
+                    f"chunking changed since the job was journaled"
+                )
+            return
+        self._plan = entries
+        self._manifest = {
+            "version": 1,
+            "job_id": self.job_id,
+            "op": self.op,
+            "created_unix": time.time(),
+            "rows": int(rows),
+            "fingerprint": fp,
+            "plan": entries,
+        }
+        if self.path is not None:
+            self._journal_write(
+                lambda: _atomic_write(
+                    os.path.join(self.path, _MANIFEST),
+                    json.dumps(self._manifest, indent=1).encode("utf-8"),
+                ),
+                what="jobs manifest-write",
+            )
+
+    # -- per-block ---------------------------------------------------------
+
+    def lookup(
+        self, i: int
+    ) -> Tuple[str, Optional[Dict[str, np.ndarray]]]:
+        """``("done", arrays)`` for a journaled block (restored from its
+        spool), ``("quarantined", None)``, or ``("todo", None)``."""
+        if i in self._quar:
+            return "quarantined", None
+        hit = self._done.get(i)
+        if hit is None:
+            return "todo", None
+        if isinstance(hit, str):  # disk spool; loaded, NOT cached — the
+            # caller consumes the arrays into its own accumulation and
+            # never looks the block up again this run, so caching here
+            # would duplicate the whole job output in host memory
+            try:
+                with np.load(
+                    os.path.join(self.path, hit), allow_pickle=True
+                ) as z:
+                    hit = {
+                        k[len(_SPOOL_PREFIX):]: z[k] for k in z.files
+                    }
+            except Exception:
+                logger.warning(
+                    "job %s: spool for block %d is unreadable; "
+                    "recomputing", self.job_id, i, exc_info=True,
+                )
+                del self._done[i]
+                return "todo", None
+        self._restored += 1
+        _m_blocks.inc(status="restored")
+        return "done", hit
+
+    def run_block(
+        self,
+        i: int,
+        compute: Callable[[], Dict[str, np.ndarray]],
+        rows: Optional[int] = None,
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Execute one block and journal the outcome. Returns the result
+        arrays, or ``None`` when the block was quarantined.
+
+        Failure classification mirrors the taxonomy in
+        ``utils/failures.py``: transient errors were already retried by
+        the compute's own ``run_with_retries`` window, so a transient
+        (or OOM) surfacing here is an infrastructure/capacity condition
+        — the job fails and is resumable. Anything else failed
+        *deterministically* and is quarantined."""
+        from ..utils import chaos as _chaos
+
+        self._check_writer()
+        try:
+            with _span("jobs.block", job=self.job_id, block=i):
+                _chaos.site("jobs.block")
+                res = compute()
+        except Exception as e:
+            if is_transient(e) or is_oom(e):
+                raise
+            self._record_quarantine(i, e, rows)
+            return None
+        self._record_done(i, res, rows)
+        return res
+
+    def _journal_write(self, fn: Callable[[], None], what: str) -> None:
+        """All journal mutations funnel through here: the chaos site
+        sits inside the retry window, so injected transients exercise
+        the retry path and injected fatals abort the job with the
+        journal still consistent (spool-then-record, both atomic)."""
+        from ..utils import chaos as _chaos
+
+        def write():
+            _chaos.site("jobs.journal_write")
+            fn()
+
+        with _span("jobs.journal_write", job=self.job_id):
+            run_with_retries(write, what=what)
+
+    # -- the background writer ---------------------------------------------
+
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._write_q.get()
+            if item is None:
+                return
+            fn, what = item
+            try:
+                self._journal_write(fn, what=what)
+            except BaseException as e:  # surfaced by _check_writer
+                self._writer_error = e
+                return
+
+    def _enqueue(self, fn: Callable[[], None], what: str) -> None:
+        """Hand a journal mutation to the writer thread. Writes stay
+        strictly ordered (one FIFO, one writer) so spool-before-record
+        holds; the block loop overlaps the next block's compute with
+        this block's disk I/O — per-block journal cost leaves the
+        critical path (the ≤ 5% overhead budget)."""
+        self._check_writer()
+        if self._write_q is None:
+            import queue
+
+            # bounded: if compute outpaces the disk, the block loop
+            # backpressures instead of accumulating every pending
+            # block's result arrays in the queue's closures
+            self._write_q = queue.Queue(maxsize=4)
+            self._writer = threading.Thread(
+                target=self._writer_loop,
+                name=f"tft-journal-{self.job_id}",
+                daemon=True,
+            )
+            self._writer.start()
+        import queue
+
+        while True:
+            # re-check between put attempts: a writer that died with the
+            # queue full must surface its error, not deadlock the put
+            self._check_writer()
+            try:
+                self._write_q.put((fn, what), timeout=1.0)
+                return
+            except queue.Full:
+                continue
+
+    def _check_writer(self) -> None:
+        if self._writer_error is not None:
+            e, self._writer_error = self._writer_error, None
+            raise e
+
+    def _drain_writer(self, swallow: bool = False) -> None:
+        """Flush the write queue and stop the writer. ``swallow`` is the
+        failure-path variant (the job is already raising; a parked
+        writer error must not mask it)."""
+        if self._writer is not None:
+            import queue
+
+            deadline = time.monotonic() + 60
+            while self._writer.is_alive():  # a dead writer needs no stop
+                try:
+                    self._write_q.put(None, timeout=1.0)
+                    break
+                except queue.Full:
+                    if time.monotonic() > deadline:
+                        break
+            self._writer.join(timeout=60)
+            wedged = self._writer.is_alive()
+            self._writer = None
+            self._write_q = None
+            if wedged:
+                # a wedged filesystem write: never share its file handle.
+                # The journal stays consistent — unrecorded blocks simply
+                # recompute on resume — but the job must not claim success
+                logger.warning(
+                    "job %s: journal writer did not drain within 60s; "
+                    "unflushed block records will recompute on resume",
+                    self.job_id,
+                )
+                if not swallow:
+                    raise RuntimeError(
+                        f"job {self.job_id}: journal writer wedged "
+                        f"(filesystem stall?); the job is resumable"
+                    )
+        if swallow:
+            self._writer_error = None
+        else:
+            self._check_writer()
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        # one handle for the job's lifetime: open/close (let alone
+        # fsync) per block costs more than a small block's compute.
+        # flush() completes the write() syscall, which is all process-
+        # death durability needs; a torn tail after an OS crash is
+        # detected and recomputed on resume.
+        f = self._ledger_file
+        if f is None or f.closed:
+            f = self._ledger_file = open(
+                os.path.join(self.path, _LEDGER), "ab"
+            )
+        f.write(json.dumps(rec).encode("utf-8") + b"\n")
+        f.flush()
+
+    def _record_done(
+        self, i: int, res: Dict[str, np.ndarray], rows: Optional[int]
+    ) -> None:
+        def counted():
+            # the "computed" tally means DURABLY recorded: a block whose
+            # record dies with the process recomputes on resume and must
+            # not have claimed completion (the soak asserts on this)
+            self._computed += 1
+            _m_blocks.inc(status="computed")
+
+        if self.path is not None:
+            rel = os.path.join(_BLOCK_DIR, f"block-{i:05d}.npz")
+            final = os.path.join(self.path, rel)
+
+            def write():
+                tmp = final + ".tmp.npz"
+                with open(tmp, "wb") as f:
+                    # keys are prefixed so a fetch named "file" (or any
+                    # other np.savez parameter name) cannot collide with
+                    # savez's own signature
+                    np.savez(
+                        f, **{_SPOOL_PREFIX + k: v for k, v in res.items()}
+                    )
+                os.replace(tmp, final)
+                self._append(
+                    {"block": i, "status": "done", "npz": rel, "rows": rows}
+                )
+                counted()
+
+            self._enqueue(write, what="jobs journal-write")
+            self._done[i] = rel
+        else:
+            counted()
+            # a sentinel, not the arrays: the op keeps its own copy of
+            # every block's output; retaining a second one here would
+            # double peak host memory on exactly the large jobs this
+            # layer exists for (an in-memory ledger can never be
+            # looked up again anyway — there is nothing to resume)
+            self._done[i] = True
+
+    def _record_quarantine(
+        self, i: int, e: BaseException, rows: Optional[int]
+    ) -> None:
+        import traceback as _tb
+
+        qb = QuarantinedBlock(
+            index=i,
+            rows=rows,
+            error_type=type(e).__name__,
+            error=str(e),
+            traceback="".join(
+                _tb.format_exception(type(e), e, e.__traceback__)
+            )[-4000:],
+        )
+        self._quar[i] = qb
+        _m_blocks.inc(status="quarantined")
+        _m_quarantined.inc()
+        logger.error(
+            "job %s: block %d failed deterministically (%s: %s); "
+            "quarantined — the job continues without it",
+            self.job_id, i, qb.error_type, qb.error.splitlines()[0]
+            if qb.error else "",
+        )
+        if self.path is not None:
+            def write():
+                self._append({"status": "quarantined", **qb.as_dict(),
+                              "block": i})
+                self._write_quarantine_manifest()
+
+            self._enqueue(write, what="jobs quarantine-write")
+
+    def _write_quarantine_manifest(self) -> None:
+        _atomic_write(
+            os.path.join(self.path, _QUARANTINE),
+            json.dumps(
+                {
+                    "job_id": self.job_id,
+                    "op": self.op,
+                    "blocks": [
+                        self._quar[k].as_dict() for k in sorted(self._quar)
+                    ],
+                },
+                indent=1,
+            ).encode("utf-8"),
+        )
+
+    def clear_quarantine(self) -> None:
+        """Forget quarantine records so those blocks re-attempt
+        (``resume_job(retry_quarantined=True)`` after an upstream fix)."""
+        if not self._quar:
+            return
+        self._quar.clear()
+        if self.path is not None:
+            def write():
+                self._append({"event": "quarantine_cleared"})
+                self._write_quarantine_manifest()
+
+            self._enqueue(write, what="jobs quarantine-clear")
+
+    def finalize(self) -> None:
+        self._drain_writer()  # all block records on disk (or raise)
+        if self.path is not None and not self._complete:
+            def write():
+                self._append({"event": "complete"})
+                # the one deliberate fsync on the whole path: a FINISHED
+                # job's journal is durable against an OS crash too
+                self._ledger_file.flush()
+                os.fsync(self._ledger_file.fileno())
+
+            self._journal_write(write, what="jobs complete-marker")
+        if self._ledger_file is not None and not self._ledger_file.closed:
+            self._ledger_file.close()
+        self._complete = True
+
+    def abort(self) -> None:
+        """Failure-path cleanup: stop the writer without masking the
+        in-flight error, keep everything already journaled (that is the
+        point), close the handle."""
+        self._drain_writer(swallow=True)
+        if self._ledger_file is not None and not self._ledger_file.closed:
+            self._ledger_file.close()
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def quarantined(self) -> List[QuarantinedBlock]:
+        return [self._quar[k] for k in sorted(self._quar)]
+
+    @property
+    def quarantined_indices(self) -> List[int]:
+        return sorted(self._quar)
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._plan or ())
+
+    @property
+    def computed(self) -> int:
+        return self._computed
+
+    @property
+    def restored(self) -> int:
+        return self._restored
+
+
+def load_quarantine(path: str) -> List[QuarantinedBlock]:
+    """Read a job's quarantine manifest (``quarantine.json``) without
+    resuming it — the ops cookbook entry point for "what poisoned my
+    job, and with which error"."""
+    try:
+        with open(os.path.join(path, _QUARANTINE), "rb") as f:
+            data = json.loads(f.read().decode("utf-8"))
+    except FileNotFoundError:
+        return []
+    return [QuarantinedBlock.from_dict(d) for d in data.get("blocks", ())]
+
+
+# ---------------------------------------------------------------------------
+# in-process job registry (surfaced in /healthz)
+# ---------------------------------------------------------------------------
+
+_status_lock = threading.Lock()
+_active: Dict[str, Dict[str, Any]] = {}
+_totals = {"runs": 0, "completed": 0, "failed": 0, "resumes": 0}
+_last: Optional[Dict[str, Any]] = None
+
+
+def _register_start(ledger: BlockLedger, resumed: bool) -> None:
+    with _status_lock:
+        _totals["runs"] += 1
+        if resumed:
+            _totals["resumes"] += 1
+        _active[ledger.job_id] = {
+            "job_id": ledger.job_id,
+            "op": ledger.op,
+            "resumed": resumed,
+            "started_unix": time.time(),
+        }
+
+
+def _register_end(ledger: BlockLedger, ok: bool) -> None:
+    global _last
+    with _status_lock:
+        info = _active.pop(ledger.job_id, {})
+        info.update(
+            state="complete" if ok else "failed",
+            blocks_total=ledger.num_blocks,
+            blocks_computed=ledger.computed,
+            blocks_restored=ledger.restored,
+            blocks_quarantined=len(ledger.quarantined_indices),
+        )
+        _totals["completed" if ok else "failed"] += 1
+        _last = info
+
+
+def jobs_status() -> Dict[str, Any]:
+    """Point-in-time batch-job summary for this process — embedded in
+    the scoring server's ``GET /healthz`` payload so operators see batch
+    health next to serving health."""
+    with _status_lock:
+        return {
+            "active": len(_active),
+            "runs_total": _totals["runs"],
+            "completed_total": _totals["completed"],
+            "failed_total": _totals["failed"],
+            "resumes_total": _totals["resumes"],
+            "last": dict(_last) if _last else None,
+        }
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def _new_job_id(op: str) -> str:
+    return f"{op}-{time.strftime('%Y%m%d-%H%M%S')}-{uuid.uuid4().hex[:6]}"
+
+
+def _execute(
+    op: str,
+    fetches,
+    data,
+    ledger: BlockLedger,
+    trim: bool,
+    feed_dict,
+    constants,
+):
+    from . import ops as _ops
+
+    if op == "map_rows":
+        return _ops.map_rows(
+            fetches, data, feed_dict=feed_dict, _ledger=ledger
+        ).cache()
+    if op == "map_blocks":
+        return _ops.map_blocks(
+            fetches, data, trim=trim, feed_dict=feed_dict,
+            constants=constants, _ledger=ledger,
+        ).cache()
+    if op == "reduce_blocks":
+        return _ops.reduce_blocks(fetches, data, _ledger=ledger)
+    return _aggregate_job(fetches, data, ledger)
+
+
+def _aggregate_job(fetches, grouped, ledger: BlockLedger):
+    """``aggregate`` executes as one coarse work unit: its segmented
+    scan has no block loop to journal, so the job records a single
+    completion with the whole output frame spooled. Resume restores the
+    frame without recomputing; a deterministic failure quarantines the
+    one block (``completed`` is then ``None``)."""
+    from . import ops as _ops
+    from ..frame import TensorFrame
+
+    frame = grouped.frame
+    n = frame.num_rows
+    # capture (memoized per callable) just for the fingerprint: resume
+    # with a different program must fail loudly, same as the other ops
+    g = _ops._as_graph(fetches, frame, cell_inputs=False)
+    ledger.ensure_plan(
+        [{"rows": n, "first": 0, "last": max(n - 1, 0)}],
+        graph=g,
+        schema=frame.schema,
+        rows=n,
+        extra={"keys": list(grouped.keys)},
+    )
+    st, arrs = ledger.lookup(0)
+    if st == "quarantined":
+        return None
+    if st == "todo":
+        def compute():
+            out = _ops.aggregate(fetches, grouped).cache()
+            spool: Dict[str, np.ndarray] = {}
+            for name in out.columns:
+                cd = out.column_data(name)
+                if cd.is_binary or cd.dense is None:
+                    cells = np.empty(cd.num_rows, dtype=object)
+                    cells[:] = list(cd.iter_cells())
+                    spool[name] = cells
+                else:
+                    spool[name] = np.asarray(cd.host())
+            return spool
+
+        arrs = ledger.run_block(0, compute, rows=n)
+        if arrs is None:
+            return None
+    cols = {
+        name: (list(arr) if arr.dtype == object else arr)
+        for name, arr in arrs.items()
+    }
+    return TensorFrame.from_columns(cols).analyze()
+
+
+def _drive(
+    ledger: BlockLedger,
+    fetches,
+    data,
+    *,
+    strict: bool,
+    trim: bool,
+    feed_dict,
+    constants,
+    resumed: bool,
+) -> JobResult:
+    _register_start(ledger, resumed)
+    ok = False
+    try:
+        with _span("jobs.run", job=ledger.job_id, op=ledger.op):
+            completed = _execute(
+                ledger.op, fetches, data, ledger, trim, feed_dict, constants
+            )
+        ledger.finalize()
+        ok = True
+    finally:
+        if not ok:
+            ledger.abort()  # keep journaled state; don't mask the error
+        _register_end(ledger, ok)
+    result = JobResult(
+        job_id=ledger.job_id,
+        op=ledger.op,
+        path=ledger.path,
+        completed=completed,
+        quarantined=ledger.quarantined,
+        resumed=resumed,
+        blocks_total=ledger.num_blocks,
+        blocks_computed=ledger.computed,
+        blocks_restored=ledger.restored,
+    )
+    if strict:
+        result.raise_if_quarantined()
+    return result
+
+
+def run_job(
+    op: str,
+    fetches,
+    data,
+    *,
+    job_dir: Optional[str] = None,
+    job_id: Optional[str] = None,
+    journal: Optional[bool] = None,
+    strict: Optional[bool] = None,
+    trim: bool = False,
+    feed_dict: Optional[Dict[str, str]] = None,
+    constants: Optional[Dict[str, Any]] = None,
+) -> JobResult:
+    """Run a batch op as a durable job.
+
+    ``op`` is one of ``map_rows`` / ``map_blocks`` / ``reduce_blocks``
+    (``data`` is a :class:`~tensorframes_tpu.frame.TensorFrame`) or
+    ``aggregate`` (``data`` is a
+    :class:`~tensorframes_tpu.frame.GroupedFrame`). Execution is
+    *eager* — durability means doing the work now, not promising it.
+
+    ``journal`` (default ``Config.journal_batch_jobs``) controls the
+    on-disk journal under ``job_dir or Config.job_dir``; ``False`` keeps
+    the deterministic block loop + quarantine semantics with no disk
+    I/O. ``strict`` (default ``not Config.quarantine_blocks``) raises
+    :class:`~tensorframes_tpu.utils.failures.QuarantinedBlocksError` at
+    job end instead of returning a partial :class:`JobResult` — healthy
+    blocks still complete and journal first, so a later
+    ``resume_job(retry_quarantined=True)`` only re-attempts the poison.
+    """
+    from ..utils import get_config
+
+    cfg = get_config()
+    if journal is None:
+        journal = cfg.journal_batch_jobs
+    if strict is None:
+        strict = not cfg.quarantine_blocks
+    if op not in _OPS:
+        raise ValueError(f"unknown job op {op!r}; expected one of {_OPS}")
+    job_id = job_id or _new_job_id(op)
+    path = None
+    if journal:
+        root = job_dir or cfg.job_dir or _default_job_dir()
+        path = os.path.join(root, job_id)
+    ledger = BlockLedger.create(path, job_id, op)
+    return _drive(
+        ledger, fetches, data, strict=strict, trim=trim,
+        feed_dict=feed_dict, constants=constants, resumed=False,
+    )
+
+
+def resume_job(
+    path: str,
+    fetches,
+    data,
+    *,
+    strict: Optional[bool] = None,
+    trim: bool = False,
+    feed_dict: Optional[Dict[str, str]] = None,
+    constants: Optional[Dict[str, Any]] = None,
+    retry_quarantined: bool = False,
+) -> JobResult:
+    """Resume a journaled job from its directory.
+
+    The caller supplies the same ``fetches`` and input ``data`` the
+    original run had (journals spool *results*, not inputs — the input
+    frame is the caller's durable artifact, as it was Spark's); the
+    manifest fingerprint and block plan are validated against them.
+    Completed blocks restore from their spools; only unfinished blocks
+    recompute, and the final output is byte-identical to a clean run.
+    ``retry_quarantined=True`` clears quarantine records first so
+    poisoned blocks re-attempt (after an upstream fix)."""
+    ledger = BlockLedger.open_(path)
+    if retry_quarantined:
+        ledger.clear_quarantine()
+    _m_resumes.inc()
+    if strict is None:
+        from ..utils import get_config
+
+        strict = not get_config().quarantine_blocks
+    return _drive(
+        ledger, fetches, data, strict=strict, trim=trim,
+        feed_dict=feed_dict, constants=constants, resumed=True,
+    )

@@ -408,6 +408,7 @@ def map_blocks(
     feed_dict: Optional[Dict[str, str]] = None,
     constants: Optional[Dict[str, Any]] = None,
     decoders: Optional[Dict[str, Callable]] = None,
+    _ledger=None,
 ) -> TensorFrame:
     """Transform the frame block by block; fetches become new columns
     (``trim=False``) or the entire output (``trim=True``, row count may
@@ -429,6 +430,12 @@ def map_blocks(
     that shape (varying shapes: use ``map_rows``, which shape-buckets).
     The result frame carries the ORIGINAL (undecoded) columns — decoded
     blocks are transient feed buffers, never a materialized column.
+
+    ``_ledger`` (private) is the durable-job hook: ``engine/jobs.py``
+    threads a :class:`~tensorframes_tpu.engine.jobs.BlockLedger` through
+    the partition loop so completed partitions restore from / spool to a
+    journal and poisoned partitions quarantine instead of killing the
+    job (docs/fault_tolerance.md).
     """
     decode_fns: Dict[str, Callable] = {}
     probe_cells: Dict[str, np.ndarray] = {}
@@ -631,6 +638,16 @@ def map_blocks(
                         int(np.prod(cell.dims)) if cell.dims else 1
                     ) * spec.scalar_type.np_dtype.itemsize * parent.num_rows
             streaming = est > budget
+        if _ledger is not None:
+            # journaled jobs: a deterministic per-partition block plan,
+            # and host materialization per block (results spool to the
+            # journal, so device residency buys nothing here)
+            _ledger.ensure_plan(
+                [{"rows": hi - lo, "lo": lo, "hi": hi} for lo, hi in bounds],
+                graph=g, schema=schema, rows=parent.num_rows,
+                extra={"trim": trim},
+            )
+            streaming = True
         # trim maps and Unknown-dim fetches have no static size estimate:
         # track actual accumulated bytes and demote to host streaming the
         # moment the budget is crossed mid-run
@@ -664,7 +681,15 @@ def map_blocks(
 
             def dispatch():
                 _chaos_site("engine.dispatch")
-                return jit_fn(feed)
+                out = jit_fn(feed)
+                if _ledger is not None:
+                    # journaled blocks materialize right after anyway;
+                    # syncing INSIDE the retry window gives transient
+                    # async failures retry coverage (the map_rows rule)
+                    import jax
+
+                    out = jax.block_until_ready(out)
+                return out
 
             try:
                 return run_with_retries(
@@ -742,7 +767,26 @@ def map_blocks(
                 # just the partitions whose outputs were lost. map_rows
                 # and the reduces, which materialize promptly, sync inside
                 # their retry windows and get full coverage.
-                res = compute_partition(p)
+                if _ledger is not None:
+                    st, res = _ledger.lookup(p)
+                    if st == "quarantined":
+                        part_sizes.append(0)
+                        continue
+                    if st == "todo":
+                        res = _ledger.run_block(
+                            p,
+                            lambda p=p: {
+                                nm: np.asarray(v)
+                                for nm, v in compute_partition(p).items()
+                                if nm in out_specs
+                            },
+                            rows=n,
+                        )
+                        if res is None:  # quarantined just now
+                            part_sizes.append(0)
+                            continue
+                else:
+                    res = compute_partition(p)
                 # results stay device-resident: shape checks need no host sync,
                 # and the host transfer happens only on host access (collect /
                 # column host materialization) — chained ops feed from HBM
@@ -821,8 +865,26 @@ def map_blocks(
         offsets = np.concatenate([[0], np.cumsum(part_sizes)]).astype(np.int64)
         if trim:
             return TensorFrame(cols, result_info, offsets=offsets)
-        for c in parent.schema:
-            cols[c.name] = parent.column_data(c.name)
+        dropped = (
+            set(_ledger.quarantined_indices) if _ledger is not None else ()
+        )
+        if dropped:
+            # quarantined partitions contribute no output rows, so the
+            # carried-through parent columns must drop the same rows to
+            # stay aligned (the partial-results contract)
+            keep = np.concatenate(
+                [
+                    np.arange(lo, hi, dtype=np.int64)
+                    for p, (lo, hi) in enumerate(bounds)
+                    if p not in dropped
+                ]
+                or [np.empty(0, np.int64)]
+            )
+            for c in parent.schema:
+                cols[c.name] = parent.column_data(c.name).take(keep)
+        else:
+            for c in parent.schema:
+                cols[c.name] = parent.column_data(c.name)
         return TensorFrame(cols, result_info, offsets=offsets)
 
     def thunk() -> TensorFrame:
@@ -960,19 +1022,34 @@ def _map_rows_thunk(
     run_bucket: Callable[[Dict[str, np.ndarray], int], Dict[str, Any]],
     result_partitions: Optional[int] = None,
     device_resident: bool = True,
+    ledger=None,
+    graph=None,
 ):
     """Shared row-map execution: bucket rows by input cell shape, assemble
     each bucket's batched feed (dense gather / ragged gather-pad / stack),
     run it through ``run_bucket(feed, m) -> {fetch: [m, ...] array}``, and
     scatter results back into row order. Used by both the local engine
     (vmap per bucket) and the distributed engine (shard_map-of-vmap with a
-    main+tail split) so bucketing/ragged semantics cannot diverge."""
+    main+tail split) so bucketing/ragged semantics cannot diverge.
+
+    ``ledger`` (with ``graph`` for the manifest fingerprint) switches on
+    durable-job execution (``engine/jobs.py``): the device-resident fast
+    path is skipped in favor of a DETERMINISTIC block plan — fixed
+    ``max_rows_per_device_call`` row slices, dense frames in row order,
+    bucketed frames per bucket in first-appearance order — so a resumed
+    job recomputes exactly the unfinished blocks and concatenates
+    byte-identically to a clean run. Quarantined blocks drop their rows
+    from the result (partial-results contract)."""
 
     def thunk() -> TensorFrame:
         from ..data import RaggedBuffer, gather_rows
 
         n = parent.num_rows
         if n == 0:
+            if ledger is not None:
+                ledger.ensure_plan(
+                    [], graph=graph, schema=parent.schema, rows=0
+                )
             cols = {
                 name: _ColumnData(
                     dense=_empty_output(out_specs[name], block_output=False)
@@ -1034,7 +1111,7 @@ def _map_rows_thunk(
         chunk = max(1, get_config().max_rows_per_device_call)
         from ..utils import is_oom, run_with_retries
 
-        def run_chunk(sub):
+        def run_chunk(sub, sink=None):
             _m_blocks_map_rows.inc()
             idx_arr = np.asarray(sub, dtype=np.int64)
             contiguous = bool(
@@ -1082,8 +1159,8 @@ def _map_rows_thunk(
                         )
                         del feed
                         mid = len(sub) // 2
-                        run_chunk(sub[:mid])
-                        run_chunk(sub[mid:])
+                        run_chunk(sub[:mid], sink)
+                        run_chunk(sub[mid:], sink)
                         return
                     from ..utils.failures import DeviceOOMError
 
@@ -1095,7 +1172,12 @@ def _map_rows_thunk(
                 raise
             for name in fetch_names:
                 arr = np.asarray(res[name])
-                if dense_fast:
+                if sink is not None:
+                    # journaled block execution collects per block (the
+                    # halving recursion preserves row order) so the block's
+                    # whole result can spool to the journal in one piece
+                    sink(name, arr)
+                elif dense_fast:
                     dense_pieces[name].append(arr)
                 else:
                     for j, i in enumerate(sub):
@@ -1234,27 +1316,136 @@ def _map_rows_thunk(
                 )
                 return None
 
+        dropped_rows: List[int] = []
         cols = (
-            run_dense_fast() if dense_fast and device_resident else None
+            run_dense_fast()
+            if dense_fast and device_resident and ledger is None
+            else None
         )
         if cols is None:
-            if dense_fast and not buckets:
-                buckets[tuple(dense_keys[ph] for ph in binding)] = list(
-                    range(n)
+            if ledger is not None:
+                # -- journaled block loop (engine/jobs.py) -----------------
+                if dense_fast:
+                    plan_subs: List[Sequence[int]] = [
+                        range(lo, min(lo + chunk, n))
+                        for lo in range(0, n, chunk)
+                    ]
+                else:
+                    plan_subs = [
+                        idxs[lo : lo + chunk]
+                        for _, idxs in buckets.items()
+                        for lo in range(0, len(idxs), chunk)
+                    ]
+
+                def plan_entry(sub):
+                    first, last = int(sub[0]), int(sub[-1])
+                    if isinstance(sub, range):
+                        total = (first + last) * len(sub) // 2
+                    else:
+                        total = int(
+                            np.asarray(sub, dtype=np.int64).sum()
+                        )
+                    return {
+                        "rows": len(sub),
+                        "first": first,
+                        "last": last,
+                        "ck": int(total % (1 << 31)),
+                    }
+
+                ledger.ensure_plan(
+                    [plan_entry(s) for s in plan_subs],
+                    graph=graph, schema=parent.schema, rows=n,
                 )
-            for _, idxs in buckets.items():
-                for lo in range(0, len(idxs), chunk):
-                    run_chunk(idxs[lo : lo + chunk])
+                for bi, sub in enumerate(plan_subs):
+                    st, arrs = ledger.lookup(bi)
+                    if st == "quarantined":
+                        dropped_rows.extend(int(i) for i in sub)
+                        continue
+                    if st == "todo":
+                        def compute(sub=sub):
+                            acc: Dict[str, List[np.ndarray]] = {
+                                name: [] for name in fetch_names
+                            }
+                            run_chunk(
+                                sub,
+                                sink=lambda name, arr: acc[name].append(arr),
+                            )
+                            return {
+                                name: (
+                                    np.concatenate(acc[name], axis=0)
+                                    if len(acc[name]) > 1
+                                    else acc[name][0]
+                                )
+                                for name in fetch_names
+                            }
+
+                        arrs = ledger.run_block(bi, compute, rows=len(sub))
+                        if arrs is None:  # quarantined just now
+                            dropped_rows.extend(int(i) for i in sub)
+                            continue
+                    for name in fetch_names:
+                        arr = arrs[name]
+                        if dense_fast:
+                            dense_pieces[name].append(arr)
+                        else:
+                            for j, i in enumerate(sub):
+                                out_cells[name][i] = arr[j]
+            else:
+                if dense_fast and not buckets:
+                    buckets[tuple(dense_keys[ph] for ph in binding)] = list(
+                        range(n)
+                    )
+                for _, idxs in buckets.items():
+                    for lo in range(0, len(idxs), chunk):
+                        run_chunk(idxs[lo : lo + chunk])
             cols = {}
+            dropped_set = set(dropped_rows)
             if dense_fast:
                 for name in fetch_names:
-                    cols[name] = _ColumnData(
-                        dense=_concat_dense(dense_pieces[name])
+                    ps = dense_pieces[name]
+                    if not ps:
+                        dense = _empty_output(
+                            out_specs[name], block_output=False
+                        )
+                    else:
+                        dense = _concat_dense(ps)
+                    cols[name] = _ColumnData(dense=dense)
+            elif dropped_set:
+                for name in fetch_names:
+                    cd, _ = _build_column(
+                        name,
+                        [
+                            out_cells[name][i]
+                            for i in range(n)
+                            if i not in dropped_set
+                        ],
                     )
+                    cols[name] = cd
             else:
                 for name in fetch_names:
                     cd, _ = _build_column(name, out_cells[name])
                     cols[name] = cd
+        if dropped_rows:
+            # quarantined blocks' rows vanish from the result: carried
+            # parent columns take the survivors, and partition offsets
+            # shrink by each partition's dropped count
+            dropped_arr = np.asarray(sorted(dropped_rows), dtype=np.int64)
+            keep = np.setdiff1d(
+                np.arange(n, dtype=np.int64), dropped_arr,
+                assume_unique=True,
+            )
+            for c in parent.schema:
+                cols[c.name] = parent.column_data(c.name).take(keep)
+            part_counts = [
+                int(hi - lo)
+                - int(np.searchsorted(dropped_arr, hi)
+                      - np.searchsorted(dropped_arr, lo))
+                for lo, hi in parent.partition_bounds()
+            ]
+            offsets = np.concatenate(
+                [[0], np.cumsum(part_counts)]
+            ).astype(np.int64)
+            return TensorFrame(cols, result_info, offsets=offsets)
         for c in parent.schema:
             cols[c.name] = parent.column_data(c.name)
         if result_partitions is not None:
@@ -1301,6 +1492,7 @@ def map_rows(
     dframe: TensorFrame,
     feed_dict: Optional[Dict[str, str]] = None,
     decoders: Optional[Dict[str, Callable]] = None,
+    _ledger=None,
 ) -> TensorFrame:
     """Transform row by row (``core.py:223-264``). Rows with equal cell
     shapes are batched and executed with ``vmap`` in one XLA program per
@@ -1320,6 +1512,12 @@ def map_rows(
         dframe.schema[col].scalar_type.name == "binary"
         for col in binding.values()
     )
+    if host_mode and _ledger is not None:
+        raise ValueError(
+            "journaled map_rows does not support binary-column host "
+            "programs; decode to numeric columns first (decoders=) and "
+            "journal the numeric pass"
+        )
     if host_mode:
         # binary programs run on the host; discover output specs from a real
         # first-row execution (the reference analyzes binary graphs via the
@@ -1418,6 +1616,8 @@ def map_rows(
             out_specs,
             result_info,
             run_bucket=lambda feed, m: _jitted_vmap(g)(feed),
+            ledger=_ledger,
+            graph=g,
         )
 
     return TensorFrame(
@@ -1446,18 +1646,24 @@ def _unpack_reduce_result(
     return vals[0] if len(vals) == 1 else vals
 
 
-def reduce_blocks(fetches, dframe: TensorFrame):
+def reduce_blocks(fetches, dframe: TensorFrame, _ledger=None):
     """Block reduce to a single row (eager; ``core.py:311-349``). One program
     run per partition block, then a fixed ``[2, ...]`` merge program folds
     the partials — replacing the reference's executors→driver funnel
-    (``DebugRowOps.scala:503-526``)."""
+    (``DebugRowOps.scala:503-526``).
+
+    ``_ledger`` (private) is the durable-job hook (``engine/jobs.py``):
+    per-partition partials spool to the journal, quarantined partitions
+    drop out of the fold, and a resume folds restored + freshly-computed
+    partials in partition order (byte-identical to a clean run). Returns
+    ``None`` when a journaled job quarantined every partition."""
     with _span("engine.reduce_blocks", partitions=dframe.num_partitions):
-        out = _reduce_blocks_impl(fetches, dframe)
+        out = _reduce_blocks_impl(fetches, dframe, _ledger)
     _m_rows.inc(dframe.num_rows, op="reduce_blocks")
     return out
 
 
-def _reduce_blocks_impl(fetches, dframe: TensorFrame):
+def _reduce_blocks_impl(fetches, dframe: TensorFrame, ledger=None):
     g = _as_graph(fetches, dframe, cell_inputs=False)
     binding = validate_reduce_block_graph(g, dframe.schema)
     _ensure_precision(g, dframe.schema)
@@ -1468,39 +1674,102 @@ def _reduce_blocks_impl(fetches, dframe: TensorFrame):
         dframe.column_block(col, None)  # rejects ragged/binary
         feeders[f], streams = _block_feeder(dframe.column_data(col))
         any_streams = any_streams or streams
-    from ..utils import run_with_retries
+    import jax.numpy as jnp
 
-    def feed_for(p):
-        lo, hi = dframe.partition_bounds()[p]
-        if hi - lo == 0:
-            return None
-        return {f"{f}_input": feeders[f](lo, hi) for f in binding}
+    from ..utils import is_oom, run_with_retries
 
-    if any_streams:
-        # a column exceeds the device cache budget and streams one block at
-        # a time — dispatch per partition with a sync each, so at most one
-        # block's buffers are live in HBM (the feeder's documented bound)
-        # and a transient failure retries only its own partition
-        partials: List[Dict[str, Any]] = []
-        for p in range(dframe.num_partitions):
-            feed = feed_for(p)
-            if feed is None:
+    bounds = dframe.partition_bounds()
+
+    def merge_two(a, b):
+        feed = {
+            f"{f}_input": jnp.stack([a[f], b[f]]) for f in binding
+        }
+        return jit_fn(feed)
+
+    def partial_for_span(lo: int, hi: int, what: str):
+        """One partial over rows [lo, hi) — with OOM degrade: a span too
+        large for HBM halves recursively and the halves merge through the
+        same ``[2, ...]`` program the partition fold uses. Sound for the
+        same reason the fold is: reduce_blocks programs are declared
+        algebraic over blocks (``Operations.scala:110-120``)."""
+        feed = {f"{f}_input": feeders[f](lo, hi) for f in binding}
+
+        def dispatch():
+            import jax
+
+            from ..utils.chaos import site as _chaos_site
+
+            _chaos_site("engine.dispatch")
+            # sync INSIDE the retry window (partials are consumed by the
+            # host-driven fold right after, so the sync costs nothing)
+            return jax.block_until_ready(jit_fn(feed))
+
+        try:
+            return run_with_retries(dispatch, what=what)
+        except Exception as e:
+            if is_oom(e):
+                if hi - lo > 1:
+                    record_oom_split("reduce_blocks")
+                    logger.warning(
+                        "reduce_blocks span of %d rows exhausted device "
+                        "memory; halving and merging the halves",
+                        hi - lo,
+                    )
+                    del feed
+                    mid = (lo + hi) // 2
+                    a = partial_for_span(lo, mid, what)
+                    b = partial_for_span(mid, hi, what)
+                    return merge_two(a, b)
+                from ..utils.failures import DeviceOOMError
+
+                raise DeviceOOMError(
+                    "reduce_blocks partial exhausted device memory even at "
+                    "a single row; the per-block reduce itself does not "
+                    "fit HBM"
+                ) from e
+            raise
+
+    if ledger is not None:
+        ledger.ensure_plan(
+            [{"rows": hi - lo, "lo": lo, "hi": hi} for lo, hi in bounds],
+            graph=g, schema=dframe.schema, rows=dframe.num_rows,
+        )
+    partials: List[Dict[str, Any]] = []
+    if ledger is not None or any_streams:
+        # per-partition dispatch with a sync each: journaled jobs need
+        # host partials to spool (and per-block failure isolation); a
+        # streaming column bounds HBM at one block's buffers. A transient
+        # failure retries only its own partition, an OOM halves it.
+        for p, (lo, hi) in enumerate(bounds):
+            if hi == lo:
                 continue
-
-            def dispatch(_feed=feed):
-                import jax
-
-                from ..utils.chaos import site as _chaos_site
-
-                _chaos_site("engine.dispatch")
-                return jax.block_until_ready(jit_fn(_feed))
-
-            partials.append(
-                run_with_retries(
-                    dispatch, what=f"reduce_blocks partition {p}"
+            what = f"reduce_blocks partition {p}"
+            if ledger is not None:
+                st, arrs = ledger.lookup(p)
+                if st == "quarantined":
+                    continue
+                if st == "done":
+                    partials.append(arrs)
+                    continue
+                res = ledger.run_block(
+                    p,
+                    lambda lo=lo, hi=hi, what=what: {
+                        f: np.asarray(v)
+                        for f, v in partial_for_span(lo, hi, what).items()
+                    },
+                    rows=hi - lo,
                 )
-            )
+                if res is not None:
+                    partials.append(res)
+            else:
+                partials.append(partial_for_span(lo, hi, what))
     else:
+
+        def feed_for(p):
+            lo, hi = bounds[p]
+            if hi - lo == 0:
+                return None
+            return {f"{f}_input": feeders[f](lo, hi) for f in binding}
 
         def all_partials() -> List[Dict[str, Any]]:
             import jax
@@ -1519,21 +1788,34 @@ def _reduce_blocks_impl(fetches, dframe: TensorFrame):
             # re-runs compute, the transfers are memoized)
             return jax.block_until_ready(ps)
 
-        partials = run_with_retries(
-            all_partials, what="reduce_blocks partials"
-        )
+        try:
+            partials = run_with_retries(
+                all_partials, what="reduce_blocks partials"
+            )
+        except Exception as e:
+            if not is_oom(e):
+                raise
+            # a partial blew HBM inside the grouped async dispatch: fall
+            # back to the sequential per-partition path, where an
+            # oversized span halves and its halves merge (the map_rows
+            # degrade contract, brought to the reduce partials path)
+            logger.warning(
+                "reduce_blocks grouped dispatch exhausted device memory; "
+                "retrying per partition with OOM halving",
+            )
+            partials = [
+                partial_for_span(lo, hi, f"reduce_blocks partition {p}")
+                for p, (lo, hi) in enumerate(bounds)
+                if hi > lo
+            ]
     if not partials:
+        if ledger is not None and ledger.quarantined_indices:
+            return None  # every partition quarantined; jobs.py surfaces it
         raise ValueError("reduce_blocks on an empty frame")
     _m_blocks.inc(len(partials), op="reduce_blocks")
-    import jax.numpy as jnp
-
     acc = partials[0]
     for part in partials[1:]:
-        feed = {
-            f"{f}_input": jnp.stack([acc[f], part[f]])
-            for f in binding
-        }
-        acc = jit_fn(feed)
+        acc = merge_two(acc, part)
     return _unpack_reduce_result(acc, g.fetch_names)
 
 

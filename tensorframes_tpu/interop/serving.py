@@ -374,14 +374,24 @@ class ScoringServer:
         """Liveness for load balancers and the chaos soak: the engine's
         :meth:`~tensorframes_tpu.serve.GenerationEngine.health` snapshot
         (last-step watchdog age, queue depth, pages in use, unhealthy
-        flags). A server with no engine is just an Arrow scorer — always
-        healthy as long as it accepts connections."""
+        flags), plus this process's batch-job summary
+        (``engine/jobs.py``: active/completed/failed runs, the last
+        job's block counts and quarantine tally) so operators see batch
+        health next to serving health. A server with no engine is just
+        an Arrow scorer — always healthy as long as it accepts
+        connections."""
         import json
 
         if self._engine is None:
             report: Dict[str, Any] = {"healthy": True, "engine": None}
         else:
             report = self._engine.health()
+        try:
+            from ..engine.jobs import jobs_status
+
+            report["jobs"] = jobs_status()
+        except Exception:  # health must never 500 over a status probe
+            report["jobs"] = None
         status = "200 OK" if report["healthy"] else "503 Service Unavailable"
         return status, json.dumps(report).encode("utf-8")
 

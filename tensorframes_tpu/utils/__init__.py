@@ -11,9 +11,11 @@ from .logging import get_logger
 from .failures import (
     DeadlineExceededError,
     DeviceOOMError,
+    QuarantinedBlocksError,
     is_oom,
     is_transient,
     run_with_retries,
+    seed_backoff_jitter,
 )
 from . import chaos
 from . import profiling
@@ -27,9 +29,11 @@ __all__ = [
     "get_logger",
     "DeadlineExceededError",
     "DeviceOOMError",
+    "QuarantinedBlocksError",
     "is_oom",
     "is_transient",
     "run_with_retries",
+    "seed_backoff_jitter",
     "chaos",
     "profiling",
 ]

@@ -15,6 +15,12 @@ Named **injection sites** sit on the host-side dispatch paths:
   compiled-step dispatches (inside their retry windows)
 - ``kv_pages.alloc`` — the KV page-pool allocator
 - ``serving.conn`` — the scoring server's per-connection handler
+- ``jobs.block`` — inside a durable batch job's per-block execution
+  (``engine/jobs.py``): a ``fatal`` here is the poison-block /
+  quarantine drill
+- ``jobs.journal_write`` — inside the job journal's write path (npz
+  spool + ledger append): a ``fatal`` here simulates a crash between
+  computing a block and recording it (the kill-and-resume drill)
 
 A site is one call: ``chaos.site("serve.decode_step")``. When no
 schedule is configured (the default) that compiles down to a single
@@ -93,6 +99,8 @@ SITES = (
     "serve.decode_step",
     "kv_pages.alloc",
     "serving.conn",
+    "jobs.block",
+    "jobs.journal_write",
 )
 
 _KINDS = ("transient", "oom", "pool", "latency", "fatal")

@@ -66,6 +66,22 @@ class Config:
     #: supplies the spec when this field is empty. Grammar and site list:
     #: ``utils/chaos.py`` and docs/fault_tolerance.md.
     chaos: str = ""
+    #: root directory for durable batch-job journals
+    #: (``engine/jobs.py``). Empty means ``$TFT_JOB_DIR`` or
+    #: ``~/.cache/tensorframes_tpu/jobs``; each job gets its own
+    #: subdirectory named by its job id.
+    job_dir: str = ""
+    #: whether :func:`tensorframes_tpu.engine.jobs.run_job` journals by
+    #: default. ``run_job(..., journal=False)`` (or this field False)
+    #: keeps the job's block loop and quarantine semantics but writes
+    #: nothing to disk — the overhead-comparison / test mode.
+    journal_batch_jobs: bool = True
+    #: default quarantine policy for batch jobs: True returns partial
+    #: results (``JobResult.completed`` + ``.quarantined``) when a block
+    #: fails deterministically; False (strict) raises
+    #: ``QuarantinedBlocksError`` at job end instead. Per-job override:
+    #: ``run_job(..., strict=)``.
+    quarantine_blocks: bool = True
 
 
 _lock = threading.Lock()

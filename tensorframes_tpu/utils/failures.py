@@ -30,9 +30,10 @@ Everything here is policy-free mechanics; knobs live in
 
 from __future__ import annotations
 
+import random
 import re
 import time
-from typing import Callable, Iterator, TypeVar
+from typing import Callable, Iterator, Optional, TypeVar
 
 from .logging import get_logger
 
@@ -42,9 +43,11 @@ __all__ = [
     "run_with_retries",
     "record_oom_split",
     "record_preemption",
+    "seed_backoff_jitter",
     "DeadlineExceededError",
     "DeviceOOMError",
     "PagePoolExhausted",
+    "QuarantinedBlocksError",
 ]
 
 logger = get_logger("failures")
@@ -149,6 +152,24 @@ class PagePoolExhausted(DeviceOOMError):
     crashing the batch (see :mod:`tensorframes_tpu.serve.scheduler`)."""
 
 
+class QuarantinedBlocksError(RuntimeError):
+    """A strict-mode batch job finished with quarantined blocks.
+
+    Quarantine (``engine/jobs.py``) records a block whose program failed
+    deterministically — non-transient, non-OOM after retries — in the
+    job's quarantine manifest and skips it, so one poison block cannot
+    kill a million-row job. In strict mode (``run_job(strict=True)`` or
+    ``Config.quarantine_blocks=False``) the job still completes every
+    healthy block and journals them, then raises this instead of
+    returning partial results. ``blocks`` holds the
+    :class:`~tensorframes_tpu.engine.jobs.QuarantinedBlock` records,
+    each carrying the real underlying error."""
+
+    def __init__(self, message: str, blocks=()):
+        super().__init__(message)
+        self.blocks = list(blocks)
+
+
 class DeadlineExceededError(TimeoutError):
     """A generation request outlived its caller-supplied deadline and was
     evicted by the serving scheduler (queued or mid-generation). A
@@ -190,10 +211,39 @@ def _op_label(what: str) -> str:
     return what.split(" ", 1)[0] if what else "unknown"
 
 
+#: RNG behind the retry backoff's full jitter. A dedicated instance (not
+#: the global ``random``) so :func:`seed_backoff_jitter` can make chaos
+#: tests deterministic without perturbing any other random consumer.
+_jitter_rng = random.Random()
+
+
+def seed_backoff_jitter(seed: Optional[int]) -> None:
+    """Re-seed the retry-backoff jitter RNG. ``None`` restores
+    OS-entropy seeding. Chaos tests call this so the (jittered) delay
+    sequence is reproducible run to run."""
+    global _jitter_rng
+    _jitter_rng = random.Random(seed)
+
+
+def _backoff_delay(attempt: int, base: float) -> float:
+    """Full-jitter exponential backoff: uniform over
+    ``(0.05 * cap, cap]`` where ``cap = base * 2**n``.
+
+    The deterministic ``base * 2**n`` schedule retried *synchronized*
+    failures in lockstep — every client that lost the same tunnel or TPU
+    runtime slammed it again at the same instant, each round. Full
+    jitter (the AWS-architecture result) decorrelates the herd while
+    keeping the same cap per attempt. The floor is a sliver of the cap
+    rather than 0 so a retry is never an immediate hot spin."""
+    cap = base * (2.0 ** attempt)
+    return _jitter_rng.uniform(0.05 * cap, cap)
+
+
 def run_with_retries(fn: Callable[[], T], what: str = "device dispatch") -> T:
-    """Run ``fn``, retrying transient runtime failures with exponential
-    backoff per the config (``max_retries`` / ``retry_backoff_s``). Raises
-    the last error when attempts run out; non-transient errors propagate
+    """Run ``fn``, retrying transient runtime failures with full-jitter
+    exponential backoff per the config (``max_retries`` /
+    ``retry_backoff_s``; see :func:`_backoff_delay`). Raises the last
+    error when attempts run out; non-transient errors propagate
     immediately."""
     from .config import get_config
 
@@ -207,7 +257,7 @@ def run_with_retries(fn: Callable[[], T], what: str = "device dispatch") -> T:
                 if is_transient(e):
                     _retries_exhausted_total.inc(op=_op_label(what))
                 raise
-            delay = cfg.retry_backoff_s * (2.0 ** attempt)
+            delay = _backoff_delay(attempt, cfg.retry_backoff_s)
             attempt += 1
             _retries_total.inc(op=_op_label(what), reason=_failure_reason(e))
             # split, not splitlines: an exception classified off its CAUSE

@@ -722,7 +722,9 @@ class TestGenerateEndpoint:
             resp = _http(addr, b"GET /healthz HTTP/1.1\r\n\r\n")
             assert int(resp.split(b" ", 2)[1]) == 200
             body = json.loads(resp.split(b"\r\n\r\n", 1)[1])
-            assert body == {"healthy": True, "engine": None}
+            assert body["healthy"] is True and body["engine"] is None
+            # batch-job status rides along (engine/jobs.py)
+            assert "runs_total" in body["jobs"]
 
     def test_shedding_answers_503_with_retry_after(self, lm):
         from tensorframes_tpu.interop.serving import ScoringServer
