@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-chaos test-durability test-multihost verify bench bench-serve bench-jobs bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-chaos test-durability test-multihost verify bench bench-serve bench-jobs bench-ingest bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -60,6 +60,12 @@ bench-serve:
 # durable-job overhead: map_rows with the journal on vs off (one JSON line)
 bench-jobs:
 	$(PY) bench.py map_rows
+
+# streaming ingest/egress: monolithic vs chunked-overlapped h2d/d2h GB/s
+# on the 3.1 GB r05 scoring column, plus cold ingest→upload→score wall
+# clock (one JSON line; TFT_BENCH_INGEST_ROWS shrinks it for smoke runs)
+bench-ingest:
+	$(PY) bench.py ingest
 
 # all BASELINE configs + extras
 bench-all:

@@ -33,6 +33,12 @@ p50/p99 INTER-TOKEN latency at 1, 4 and 16 concurrent requests — the
 serving trajectory the ROADMAP's heavy-traffic target is measured by.
 Also exactly one JSON line.
 
+``python bench.py ingest`` (``make bench-ingest``) benchmarks the
+streaming transfer layer (``tensorframes_tpu/frame/transfer.py``):
+monolithic vs chunked-overlapped h2d/d2h GB/s on the same 3.1 GB
+column, plus the cold ingest→upload→score wall clock. Also exactly one
+JSON line.
+
 ``python bench.py map_rows`` benchmarks the durable batch-job layer
 (``tensorframes_tpu/engine/jobs.py``): the same ``map_rows`` job with
 the journal **on** vs **off** (identical block loop; the delta is the
@@ -49,6 +55,19 @@ import numpy as np
 #: TPU v5e (v5 lite) public peaks, for the roofline estimate
 _V5E_PEAK_BF16_FLOPS = 197e12
 _V5E_HBM_BYTES_PER_S = 819e9
+
+
+def _transfer_settings():
+    """The active streaming-transfer knobs, for the bench JSON (a tuned
+    chunk size / stream count must be readable off the trajectory)."""
+    from tensorframes_tpu.utils import get_config
+
+    cfg = get_config()
+    return {
+        "chunk_bytes": cfg.transfer_chunk_bytes,
+        "streams": cfg.transfer_streams,
+        "wire_dtype": cfg.transfer_dtype or "verbatim",
+    }
 
 
 def _numpy_baseline(x, w, b, iters=3):
@@ -81,7 +100,10 @@ def main():
 
     # 1M rows: the per-dispatch latency of the TPU link amortizes across a
     # large block, which is the intended usage pattern for block scoring
-    n_rows, n_features, n_classes = 1_000_000, 784, 10
+    # (TFT_BENCH_ROWS shrinks it for smoke runs; published numbers use
+    # the default)
+    n_rows = int(os.environ.get("TFT_BENCH_ROWS", "1000000"))
+    n_features, n_classes = 784, 10
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n_rows, n_features)).astype(np.float32)
 
@@ -100,9 +122,30 @@ def main():
     # compilation (eliminated for warm processes by the persistent cache,
     # round-5 fix — compare this section cold vs warm), and
     # warmup+verify is the first real pass + correctness check.
+    #
+    # upload runs through the streaming transfer layer (chunked +
+    # concurrent, frame/transfer.py — the round-6 fix for the 313.9 s /
+    # 0.01 GB/s monolithic device_put of r05). The monolithic baseline is
+    # sampled on a capped slice first (the full column at tunnel speeds
+    # would add minutes; `make bench-ingest` runs the full-column
+    # comparison): same link, same dtype, one blocking device_put.
+    # untimed link warmup: the FIRST device transfer of a process absorbs
+    # backend/allocator setup, and it must not land inside (and bias)
+    # either timed mode
+    jax.block_until_ready(jax.device_put(x[: min(n_rows, 1024)]))
+    mono_rows = max(1, min(n_rows, (128 << 20) // (n_features * 4)))
+    t0 = time.perf_counter()
+    _mono = jax.device_put(x[:mono_rows])
+    jax.block_until_ready(_mono)
+    dt_mono_sample = time.perf_counter() - t0
+    upload_mono_gb_per_s = x[:mono_rows].nbytes / 1e9 / dt_mono_sample
+    try:
+        _mono.delete()
+    except Exception:
+        pass
     with timer.section("upload"):
         feat_dev = df.column_data("features").device()
-        np.asarray(feat_dev.ravel()[:1])  # force the transfer (advisory sync)
+        jax.block_until_ready(feat_dev)
     with timer.section("precompile"):
         tft.precompile(g, df)
     with timer.section("warmup+verify"):
@@ -175,6 +218,16 @@ def main():
         dt_bf16 = (time.perf_counter() - t0) / iters
 
     # -- host-fetch modes --------------------------------------------------
+    # host_pipelined rides the streaming transfer layer's chunked
+    # concurrent d2h. The old ``copy_to_host_async`` double-buffering was
+    # measured ~2.2x SLOWER than host_sequential in BENCH_r05 (4.15 s vs
+    # 1.86 s/pass): the async copies serialized behind each pass's compute
+    # on the tunnel and ``np.asarray`` re-synchronized per array, so the
+    # overlap cost more than it bought. ``d2h_async`` instead fans each
+    # result out as transfer chunks on the pool the moment the pass is
+    # dispatched, so fetch of pass i overlaps compute of pass i+1.
+    from tensorframes_tpu.frame import transfer as _transfer
+
     h_iters = 8
     with timer.section("host_pipelined"):
         t0 = time.perf_counter()
@@ -182,9 +235,8 @@ def main():
         for _ in range(h_iters):
             sf = map_blocks(g, df)
             arr = sf.column_data("prediction").device()
-            arr.copy_to_host_async()
-            pending.append(arr)
-        outs = [np.asarray(a) for a in pending]
+            pending.append(_transfer.d2h_async(arr, what="bench"))
+        outs = [p.result() for p in pending]
         dt_host_pipe = (time.perf_counter() - t0) / h_iters
     assert all(o.shape == (n_rows,) for o in outs)
 
@@ -263,10 +315,23 @@ def main():
                     },
                     # workload data movement — recurs per process, cache-
                     # INDEPENDENT (a real TPU host moves the same bytes
-                    # over PCIe at ~10 GB/s; this is the tunnel)
+                    # over PCIe at ~10 GB/s; this is the tunnel). Chunked +
+                    # overlapped through frame/transfer.py; the monolithic
+                    # row is the old single-device_put path sampled on a
+                    # capped slice of the same column (full-column
+                    # comparison: `make bench-ingest`)
                     "upload_gb_per_s": round(
                         x.nbytes / 1e9 / timer.totals["upload"], 3
                     ),
+                    "upload_monolithic_gb_per_s": round(
+                        upload_mono_gb_per_s, 3
+                    ),
+                    "upload_speedup_vs_monolithic": round(
+                        (x.nbytes / 1e9 / timer.totals["upload"])
+                        / upload_mono_gb_per_s,
+                        2,
+                    ),
+                    "transfer": _transfer_settings(),
                     "compilation_cache": {
                         "dir": cache_dir,
                         "entries_at_start": cache_entries_before,
@@ -478,6 +543,120 @@ def main_map_rows_journal():
     )
 
 
+def main_ingest():
+    """Streaming-ingest bench (``make bench-ingest``): the round-5
+    pathology head-on. One 1M×784 f32 column (3.1 GB — the exact r05
+    scoring workload; shrink with ``TFT_BENCH_INGEST_ROWS`` for smoke
+    runs) crosses the link twice each way:
+
+    - **monolithic**: one blocking ``jax.device_put`` / ``np.asarray`` —
+      the pre-round-6 path (313.9 s at 0.01 GB/s in BENCH_r05);
+    - **chunked-overlapped**: the streaming transfer layer
+      (``frame/transfer.py``) with the active ``transfer_chunk_bytes`` /
+      ``transfer_streams`` knobs.
+
+    Plus the cold end-to-end ingest→upload→score wall clock through the
+    engine (frame build, chunked upload, one ``map_blocks`` scoring
+    pass). Exactly one JSON line; ``value`` is the chunked h2d GB/s and
+    ``vs_baseline`` the speedup over monolithic on the same workload."""
+    import os
+
+    import jax
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.engine import map_blocks
+    from tensorframes_tpu.frame import transfer
+    from tensorframes_tpu.models import MLPClassifier
+
+    tft.enable_compilation_cache()
+    n_rows = int(os.environ.get("TFT_BENCH_INGEST_ROWS", "1000000"))
+    n_features, n_classes = 784, 10
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, n_features)).astype(np.float32)
+    gb = x.nbytes / 1e9
+
+    # untimed warmup: first-transfer backend/allocator setup must not
+    # bias the monolithic-vs-chunked comparison (both run warm)
+    warm = jax.device_put(x[: min(n_rows, 1024)])
+    jax.block_until_ready(np.asarray(warm))
+    del warm
+
+    # -- h2d monolithic: ONE blocking device_put (the r05 upload path) ----
+    t0 = time.perf_counter()
+    mono = jax.device_put(x)
+    jax.block_until_ready(mono)
+    dt_h2d_mono = time.perf_counter() - t0
+
+    # -- d2h monolithic: one blocking np.asarray --------------------------
+    t0 = time.perf_counter()
+    back_mono = np.asarray(mono)
+    dt_d2h_mono = time.perf_counter() - t0
+    del back_mono
+    try:
+        mono.delete()
+    except Exception:
+        pass
+    del mono
+
+    # -- chunked + overlapped, cold end-to-end through the engine ---------
+    clf = MLPClassifier.init(0, [n_features, n_classes])
+    t_cold = time.perf_counter()
+    df = tft.TensorFrame.from_columns({"features": x}).analyze()
+    t0 = time.perf_counter()
+    feat = df.column_data("features").device()
+    jax.block_until_ready(feat)
+    dt_h2d_chunked = time.perf_counter() - t0
+    g = clf._scoring_graph(df, "features", "prediction", None)
+    pred = map_blocks(g, df).column_data("prediction").device()
+    jax.block_until_ready(pred)
+    dt_cold = time.perf_counter() - t_cold
+
+    # -- d2h chunked (symmetric path), with byte-identity checked ---------
+    t0 = time.perf_counter()
+    back = transfer.d2h(feat)
+    dt_d2h_chunked = time.perf_counter() - t0
+    identical = bool(np.array_equal(back, x))
+    del back
+
+    n_chunks = len(transfer._chunk_bounds(n_rows, n_features * 4))
+
+    print(
+        json.dumps(
+            {
+                "metric": "ingest_upload_gb_per_s",
+                "value": round(gb / dt_h2d_chunked, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(dt_h2d_mono / dt_h2d_chunked, 2),
+                "detail": {
+                    "workload": (
+                        f"{n_rows} x {n_features} f32 column "
+                        f"({gb:.2f} GB), h2d + d2h, monolithic vs "
+                        f"chunked-overlapped"
+                    ),
+                    "device": str(jax.devices()[0]),
+                    "upload_gb_per_s": {
+                        "monolithic": round(gb / dt_h2d_mono, 3),
+                        "chunked_overlapped": round(gb / dt_h2d_chunked, 3),
+                    },
+                    "upload_seconds": {
+                        "monolithic": round(dt_h2d_mono, 3),
+                        "chunked_overlapped": round(dt_h2d_chunked, 3),
+                    },
+                    "fetch_gb_per_s": {
+                        "monolithic": round(gb / dt_d2h_mono, 3),
+                        "chunked": round(gb / dt_d2h_chunked, 3),
+                    },
+                    "cold_ingest_upload_score_seconds": round(dt_cold, 3),
+                    "chunks": n_chunks,
+                    "transfer": _transfer_settings(),
+                    "byte_identity": identical,
+                },
+            }
+        )
+    )
+    assert identical, "chunked transfer round-trip is not byte-identical"
+
+
 if __name__ == "__main__":
     import sys
 
@@ -485,5 +664,7 @@ if __name__ == "__main__":
         main_decode_serve()
     elif len(sys.argv) > 1 and sys.argv[1] == "map_rows":
         main_map_rows_journal()
+    elif len(sys.argv) > 1 and sys.argv[1] == "ingest":
+        main_ingest()
     else:
         main()

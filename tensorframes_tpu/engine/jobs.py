@@ -30,6 +30,12 @@ everything around it. This module is the missing durability layer:
   spools and only unfinished blocks recompute. The block plan is
   deterministic (partition bounds / fixed row chunks in a fixed bucket
   order), so a resumed job's output is byte-identical to a clean run.
+  Dense ``map_rows`` plans are additionally ALIGNED to the streaming
+  transfer layer's chunk quantum (``frame/transfer.py``: block rows =
+  ``min(max_rows_per_device_call, transfer_chunk_bytes // row_bytes)``),
+  and feeds cross the link per block — a resumed job re-uploads exactly
+  its unfinished blocks' bytes, never the completed ones
+  (tests/test_jobs.py asserts on the ``frame.h2d_bytes_total`` delta).
 - **quarantine**: a block whose program fails *deterministically*
   (non-transient, non-OOM after retries — the Spark-blacklisting
   analogue) is recorded with the real error in ``quarantine.json``,
@@ -387,6 +393,15 @@ class BlockLedger:
 
     # -- per-block ---------------------------------------------------------
 
+    def peek(self, i: int) -> str:
+        """Block status WITHOUT restoring: ``"done"`` / ``"quarantined"``
+        / ``"todo"``. Side-effect-free (no spool load, no counters) —
+        prefetchers use it to skip work for blocks that will never
+        recompute."""
+        if i in self._quar:
+            return "quarantined"
+        return "done" if i in self._done else "todo"
+
     def lookup(
         self, i: int
     ) -> Tuple[str, Optional[Dict[str, np.ndarray]]]:
@@ -689,6 +704,17 @@ class BlockLedger:
             self._ledger_file.close()
 
     # -- introspection -----------------------------------------------------
+
+    @property
+    def stored_plan(self) -> Optional[List[Dict[str, Any]]]:
+        """The block plan already on record — the journaled plan when
+        resuming, ``None`` for a fresh job (before ``ensure_plan``).
+        Resumable ops rebuild their block loop FROM this instead of
+        re-deriving it from live config, so tuning a knob that shapes
+        fresh plans (``transfer_chunk_bytes``, ``transfer_dtype``,
+        ``max_rows_per_device_call``) between a run and its resume
+        cannot invalidate the journal."""
+        return self._plan
 
     @property
     def quarantined(self) -> List[QuarantinedBlock]:

@@ -36,6 +36,7 @@ import numpy as np
 from ..capture import CapturedGraph, Node, TensorSpec, build_graph
 from ..capture import dsl as _dsl
 from ..frame import GroupedFrame, TensorFrame
+from ..frame import transfer as _transfer
 from ..frame.table import _build_column, _ColumnData
 from ..obs import span as _span
 from ..obs.metrics import counter as _counter
@@ -321,9 +322,12 @@ def _jitted_vmap(g: CapturedGraph):
 def _block_feeder(cd):
     """Per-partition feed source for a dense column, plus whether it streams.
 
-    Returns ``(feed_fn, streams_host)``: the memoized device copy (sliced on
-    device) when the column fits the device-cache budget, else host slices
-    streamed one block at a time so HBM stays bounded by a single block.
+    Returns ``(feed_fn, streams_host)``: a chunked-upload stream slicer
+    when the column fits the device-cache budget (the first blocks
+    compute while later transfer chunks are still in the air; once every
+    chunk has landed the memoized assembled column feeds exactly like
+    the old whole-``device_put`` copy), else host slices streamed one
+    block at a time so HBM stays bounded by a single block.
     Device-resident columns (results of a previous op) feed directly — no
     transfer, no budget check."""
     from ..frame.table import _is_device_array
@@ -340,7 +344,7 @@ def _block_feeder(cd):
     if _is_device_array(dense):
         return _slicer(dense), False
     if dense.nbytes <= get_config().device_cache_bytes:
-        return _slicer(cd.device()), False
+        return cd.device_stream().slice, False
     return (lambda lo, hi: dense[lo:hi]), True
 
 
@@ -399,6 +403,14 @@ def _resolve_decoder_cols(
 #: partitions of decoded blocks kept in flight ahead of the device: decode
 #: of partition p+1..p+N proceeds on the host pool while the chip runs p
 _DECODE_PREFETCH = 4
+
+#: host-streamed (over-budget) columns keep this many partition uploads
+#: in flight ahead of the device: block i+1 crosses the link while the
+#: chip runs block i (the streaming-ingest overlap). ONE ahead — these
+#: blocks belong to a column that exceeded the device-cache budget, so
+#: the streaming contract of ~one resident block loosens to exactly two
+#: (current + next), the minimum that buys any overlap at all
+_UPLOAD_PREFETCH = 1
 
 
 def map_blocks(
@@ -606,9 +618,46 @@ def map_blocks(
 
             return feeder
 
+        # host-streamed (over-budget) columns upload through a PREFETCHING
+        # pipeline: partition p+1's block crosses the link while the chip
+        # runs p, each block retried per chunk by the transfer layer —
+        # the same submit/pop state machine as the decode prefetch above
+        # (a recovery re-run of a consumed partition simply resubmits)
+        upload_pool = None
+        upload_futs: Dict[Tuple[str, int], Any] = {}
+
+        def _submit_upload(
+            ph: str, p: int, host_feed, prefetch: bool = False
+        ) -> None:
+            if (ph, p) in upload_futs or p >= len(bounds):
+                return
+            if prefetch and _ledger is not None and _ledger.peek(p) != "todo":
+                # journaled pass: restored/quarantined blocks never
+                # recompute, so their bytes must never cross the link
+                # (the demanded block itself is always todo — only the
+                # speculative window consults the ledger)
+                return
+            lo, hi = bounds[p]
+            if hi == lo:
+                return
+            upload_futs[(ph, p)] = upload_pool.submit(
+                _transfer.h2d, host_feed(lo, hi), f"map_blocks block {p}"
+            )
+
+        def _make_upload_feeder(ph: str, host_feed):
+            def feeder(lo: int, hi: int):
+                p = part_of[(lo, hi)]
+                _submit_upload(ph, p, host_feed)
+                for q in range(p + 1, p + 1 + _UPLOAD_PREFETCH):
+                    _submit_upload(ph, q, host_feed, prefetch=True)
+                return upload_futs.pop((ph, p)).result()
+
+            return feeder
+
         # device-resident columns when they fit; streamed blocks otherwise
         feeders = {}
         streaming = False
+        streamed_phs: List[str] = []
         for ph, col in binding.items():
             if col in decode_fns:
                 if decode_pool is None:
@@ -622,7 +671,21 @@ def map_blocks(
                 continue
             parent.column_block(col, None)  # rejects ragged/binary
             feeders[ph], streams = _block_feeder(parent.column_data(col))
+            if streams:
+                streamed_phs.append(ph)
             streaming = streaming or streams
+        if streamed_phs:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # one waited block + the prefetch window PER streamed column:
+            # a shared too-small pool would queue column B's current
+            # block behind column A's prefetch and serialize the pass
+            upload_pool = ThreadPoolExecutor(
+                min(16, (1 + _UPLOAD_PREFETCH) * len(streamed_phs)),
+                thread_name_prefix="tft-upload-prefetch",
+            )
+            for ph in streamed_phs:
+                feeders[ph] = _make_upload_feeder(ph, feeders[ph])
         # Outputs stay device-resident only when HBM stays bounded: if any
         # input streams from the host (over-budget column), or the full
         # output itself would blow the device-cache budget, pull each
@@ -674,8 +737,6 @@ def map_blocks(
             lo, hi = bounds[p]
             n = hi - lo
             _m_blocks_map_blocks.inc()
-            feed = {ph: feeders[ph](lo, hi) for ph in binding}
-            feed.update(const_feed)
             from ..utils import is_oom, run_with_retries
             from ..utils.chaos import site as _chaos_site
 
@@ -692,6 +753,12 @@ def map_blocks(
                 return out
 
             try:
+                # feed assembly sits INSIDE the OOM envelope: for
+                # host-streamed columns it includes the block's device
+                # upload (prefetched or synchronous), and an OOM there
+                # deserves the same repartition hint as one in compute
+                feed = {ph: feeders[ph](lo, hi) for ph in binding}
+                feed.update(const_feed)
                 return run_with_retries(
                     dispatch, what=f"map_blocks partition {p}"
                 )
@@ -862,6 +929,8 @@ def map_blocks(
         finally:
             if decode_pool is not None:
                 decode_pool.shutdown(wait=False, cancel_futures=True)
+            if upload_pool is not None:
+                upload_pool.shutdown(wait=False, cancel_futures=True)
         offsets = np.concatenate([[0], np.cumsum(part_sizes)]).astype(np.int64)
         if trim:
             return TensorFrame(cols, result_info, offsets=offsets)
@@ -1024,6 +1093,7 @@ def _map_rows_thunk(
     device_resident: bool = True,
     ledger=None,
     graph=None,
+    explicit_h2d: bool = False,
 ):
     """Shared row-map execution: bucket rows by input cell shape, assemble
     each bucket's batched feed (dense gather / ragged gather-pad / stack),
@@ -1031,6 +1101,14 @@ def _map_rows_thunk(
     scatter results back into row order. Used by both the local engine
     (vmap per bucket) and the distributed engine (shard_map-of-vmap with a
     main+tail split) so bucketing/ragged semantics cannot diverge.
+
+    ``explicit_h2d`` (the local engine) moves each chunk's feed to device
+    through the streaming transfer layer (``frame/transfer.py``) before
+    dispatch: the upload is retried per transfer chunk, counted as link
+    traffic, and chaos-injectable at ``frame.h2d`` — a transient tunnel
+    error during ingest retries one chunk instead of killing the pass.
+    The distributed engine keeps host feeds (its shard_map programs own
+    their sharded placement).
 
     ``ledger`` (with ``graph`` for the manifest fingerprint) switches on
     durable-job execution (``engine/jobs.py``): the device-resident fast
@@ -1146,6 +1224,20 @@ def _map_rows_thunk(
                 return jax.block_until_ready(run_bucket(feed, len(sub)))
 
             try:
+                if explicit_h2d:
+                    # feeds cross the link through the streaming layer:
+                    # each transfer chunk retried + counted + chaos-
+                    # injectable; a dispatch retry below reuses the
+                    # already-landed arrays. Inside THIS try so a device
+                    # OOM during the upload halves the chunk like any
+                    # other OOM (the recovery envelope must cover the
+                    # feed bytes too, not just the program's activations)
+                    feed = {
+                        ph: _transfer.h2d(v, what="map_rows feed")
+                        if isinstance(v, np.ndarray)
+                        else v
+                        for ph, v in feed.items()
+                    }
                 res = run_with_retries(dispatch, what="map_rows chunk")
             except Exception as e:
                 # rows are independent, so an OOM chunk is safe to halve
@@ -1326,10 +1418,52 @@ def _map_rows_thunk(
             if ledger is not None:
                 # -- journaled block loop (engine/jobs.py) -----------------
                 if dense_fast:
-                    plan_subs: List[Sequence[int]] = [
-                        range(lo, min(lo + chunk, n))
-                        for lo in range(0, n, chunk)
-                    ]
+                    # resume: rebuild the SAME plan the journal was
+                    # written with (contiguous row ranges straight off
+                    # the manifest) — knobs that shape FRESH plans may
+                    # have been retuned since, and a resume must restore
+                    # completed blocks, not reject them over a config
+                    # delta. The fingerprint still validates everything
+                    # else, and ensure_plan re-checks entry equality.
+                    plan_subs: Optional[List[Sequence[int]]] = None
+                    stored = ledger.stored_plan
+                    if stored:
+                        subs: List[Sequence[int]] = []
+                        nxt = 0
+                        for e in stored:
+                            first, last = e.get("first"), e.get("last")
+                            if (
+                                first != nxt
+                                or last is None
+                                or e.get("rows") != last - first + 1
+                            ):
+                                subs = None  # bucketed/foreign plan
+                                break
+                            subs.append(range(first, last + 1))
+                            nxt = last + 1
+                        if subs is not None and nxt == n:
+                            plan_subs = subs
+                    if plan_subs is None:
+                        # fresh job: the plan chunk is CAPPED at the
+                        # transfer-chunk row quantum so a journal block
+                        # never spans transfer chunks — a resumed job
+                        # re-uploads exactly its unfinished blocks'
+                        # bytes and nothing of the completed ones
+                        # (docs/ingest.md)
+                        per_row_bytes = sum(
+                            _transfer.wire_dtype(cd.dense.dtype).itemsize
+                            * int(np.prod(cd.dense.shape[1:], initial=1))
+                            for cd in col_data.values()
+                            if cd.dense is not None
+                        )
+                        plan_chunk = max(
+                            1,
+                            min(chunk, _transfer.chunk_rows(per_row_bytes)),
+                        )
+                        plan_subs = [
+                            range(lo, min(lo + plan_chunk, n))
+                            for lo in range(0, n, plan_chunk)
+                        ]
                 else:
                     plan_subs = [
                         idxs[lo : lo + chunk]
@@ -1618,6 +1752,7 @@ def map_rows(
             run_bucket=lambda feed, m: _jitted_vmap(g)(feed),
             ledger=_ledger,
             graph=g,
+            explicit_h2d=True,
         )
 
     return TensorFrame(

@@ -30,7 +30,6 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 import numpy as np
 
 from ..obs import span as _span
-from ..obs.metrics import counter as _counter
 from ..schema import (
     BINARY,
     ColumnInfo,
@@ -42,16 +41,9 @@ from ..schema import (
 
 __all__ = ["Row", "TensorFrame", "GroupedFrame", "frame_from_pandas"]
 
-#: link-traffic accounting at the two memoized transfer points
-#: (``_ColumnData.device()`` / ``host()``) — each column crosses at most
-#: once per direction, so these measure real bytes over the PCIe/tunnel
-#: link, not access counts
-_m_h2d = _counter(
-    "frame.h2d_bytes_total", "Host-to-device column transfer bytes"
-)
-_m_d2h = _counter(
-    "frame.d2h_bytes_total", "Device-to-host column transfer bytes"
-)
+# link-traffic metrics (frame.h2d_bytes_total / frame.d2h_bytes_total,
+# per-chunk latency histograms, the inflight-chunks gauge) live with the
+# transfer machinery itself in ``frame/transfer.py``
 
 
 class Row(dict):
@@ -100,11 +92,15 @@ class _ColumnData:
     every Session.run, ``TFDataOps.scala:27-59``). ``cells`` is a list of
     per-row payloads (ragged / binary). ``device()``/``host()`` memoize the
     other-side copy — columns are immutable, so each transfer happens once.
+    Transfers go through the streaming layer (``frame/transfer.py``):
+    chunked, concurrent, retried, and chaos-injectable; ``device_stream()``
+    exposes the in-flight chunks so block loops can compute on chunk *i*
+    while chunk *i+1* is still crossing the link.
     """
 
     __slots__ = (
         "dense", "cells", "is_binary", "_device_arr", "_host_arr",
-        "_sharded_cache",
+        "_sharded_cache", "_stream",
     )
 
     def __init__(self, dense=None, cells=None, is_binary=False):
@@ -115,32 +111,54 @@ class _ColumnData:
         self._host_arr = None
         #: per-(mesh, split) device-sharded copies (parallel engine)
         self._sharded_cache = None
+        #: in-flight chunked upload (transfer.StreamingUpload), kept until
+        #: device() memoizes its assembled column
+        self._stream = None
 
-    def device(self):
-        """The dense column as a device-resident jax array (memoized)."""
+    def device_stream(self):
+        """Streaming handle over this column's device form: ``slice(lo,
+        hi)`` waits only for the chunks covering that row range (compute
+        overlaps the rest of the upload), ``assembled()`` is the whole
+        column. Memoized — repeated calls reuse landed chunks, and a
+        column already on device streams trivially."""
+        from . import transfer as _transfer
+
         if self.dense is None:
             raise ValueError("only dense columns have a device form")
         if _is_device_array(self.dense):
-            return self.dense
-        if self._device_arr is None or (
-            self._device_arr.dtype != self.dense.dtype
+            return _transfer._Resident(self.dense)
+        if self._device_arr is not None and (
+            self._device_arr.dtype == self.dense.dtype
         ):
-            import jax
+            return _transfer._Resident(self._device_arr)
+        want = _transfer.wire_dtype(self.dense.dtype)
+        if self._stream is None or self._stream.wire != want:
+            self._stream = _transfer.StreamingUpload(
+                self.dense, what="column"
+            )
+        return self._stream
 
-            self._device_arr = jax.device_put(self.dense)
-            _m_h2d.inc(self.dense.nbytes)
-        return self._device_arr
+    def device(self):
+        """The dense column as a device-resident jax array (memoized)."""
+        stream = self.device_stream()
+        arr = stream.assembled()
+        if not _is_device_array(self.dense):
+            self._device_arr = arr
+            self._stream = None
+        return arr
 
     def host(self) -> np.ndarray:
         """The dense column as a host numpy array (memoized; this is the
-        point where a device-resident result synchronizes)."""
+        point where a device-resident result synchronizes — chunked and
+        concurrent through ``frame/transfer.py``)."""
         if self.dense is None:
             raise ValueError("only dense columns have a host block form")
         if not _is_device_array(self.dense):
             return self.dense
         if self._host_arr is None:
-            self._host_arr = np.asarray(self.dense)
-            _m_d2h.inc(self._host_arr.nbytes)
+            from . import transfer as _transfer
+
+            self._host_arr = _transfer.d2h(self.dense, what="column")
         return self._host_arr
 
     @property
@@ -467,6 +485,7 @@ class TensorFrame:
                 cd._host_arr = None
             cd._device_arr = None
             cd._sharded_cache = None
+            cd._stream = None
         self._mh_global = None
         return self
 

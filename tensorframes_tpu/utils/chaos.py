@@ -21,6 +21,10 @@ Named **injection sites** sit on the host-side dispatch paths:
 - ``jobs.journal_write`` — inside the job journal's write path (npz
   spool + ledger append): a ``fatal`` here simulates a crash between
   computing a block and recording it (the kill-and-resume drill)
+- ``frame.h2d`` / ``frame.d2h`` — inside every streaming-transfer
+  chunk's retry window (``frame/transfer.py``): a ``transient`` here is
+  the flaky-tunnel-during-ingest drill (one chunk retries; the column
+  still lands byte-identical)
 
 A site is one call: ``chaos.site("serve.decode_step")``. When no
 schedule is configured (the default) that compiles down to a single
@@ -101,6 +105,8 @@ SITES = (
     "serving.conn",
     "jobs.block",
     "jobs.journal_write",
+    "frame.h2d",
+    "frame.d2h",
 )
 
 _KINDS = ("transient", "oom", "pool", "latency", "fatal")

@@ -41,6 +41,25 @@ class Config:
     #: back toward the row cap without leaving the device-resident path).
     #: Consumed by engine/ops.py.
     max_bytes_per_device_call: int = 64 << 20
+    #: chunk size for the streaming host↔device transfer layer
+    #: (``frame/transfer.py``): column-sized payloads cross the link as
+    #: row chunks of at most this many bytes, several in flight at once,
+    #: so consumers overlap compute with the chunks still in the air.
+    #: ``<= 0`` restores the monolithic single-``device_put`` path
+    #: (still retried and counted). See docs/ingest.md for tuning.
+    transfer_chunk_bytes: int = 64 << 20
+    #: width of the transfer thread pool: how many chunks are in flight
+    #: concurrently, per direction. A single stream cannot fill a
+    #: high-latency link; more streams pipeline against each other until
+    #: the link saturates (guidance in docs/ingest.md).
+    transfer_streams: int = 4
+    #: optional WIRE cast for float32 payloads: ``"bf16"`` crosses the
+    #: link as bfloat16 (half the tunnel bytes) and upcasts back to
+    #: float32 on device — schemas, programs, and device dtypes are
+    #: untouched, only the values round to bf16 precision (the accuracy
+    #: trade the bf16 bench mode measures; see docs/ingest.md caveats).
+    #: ``""`` (default) transfers verbatim — the byte-identity mode.
+    transfer_dtype: str = ""
     #: retries for transient device-runtime failures (UNAVAILABLE /
     #: DEADLINE_EXCEEDED / dropped tunnel); see utils/failures.py. The
     #: reference rode Spark's task retry instead (SURVEY §5).

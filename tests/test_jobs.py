@@ -383,6 +383,89 @@ class TestCrashResume:
         assert res2.blocks_computed == 1 and res2.blocks_restored == 5
         assert np.array_equal(_col(res2.completed), _col(res.completed))
 
+    @pytest.mark.chaos
+    def test_resume_never_reuploads_completed_blocks(
+        self, tmp_path, small_chunks
+    ):
+        """Block plans align with transfer chunks and feeds cross the
+        link per block (``frame/transfer.py``), so a resume's
+        ``frame.h2d_bytes_total`` delta is EXACTLY the unfinished
+        blocks' input bytes — journaled blocks restore from their npz
+        spools without touching the link."""
+        df = _frame()  # 96 rows x 4 f32 -> 6 blocks of 16 at the cap
+        block_bytes = 16 * 4 * 4
+        path = str(tmp_path / "noreup")
+        with chaos.scoped("jobs.journal_write=fatal:every=3:times=1"):
+            with pytest.raises(ChaosFault):
+                run_job(
+                    "map_rows", _fn, df,
+                    job_dir=str(tmp_path), job_id="noreup",
+                )
+        recorded = len(
+            [
+                ln
+                for ln in open(os.path.join(path, "ledger.jsonl"))
+                if '"done"' in ln
+            ]
+        )
+        assert 0 < recorded < 6, "the kill left a partial journal"
+        h0 = _counter("frame.h2d_bytes_total")
+        res = resume_job(path, _fn, df)
+        uploaded = _counter("frame.h2d_bytes_total") - h0
+        assert res.blocks_restored == recorded
+        assert res.blocks_computed == 6 - recorded
+        assert uploaded == (6 - recorded) * block_bytes
+        assert uploaded < df.num_rows * 4 * 4  # never the whole column
+        assert np.array_equal(_col(res.completed), _col(tft.map_rows(_fn, df)))
+
+    @pytest.mark.chaos
+    def test_resume_survives_transfer_knob_retune(
+        self, tmp_path, small_chunks
+    ):
+        """The dense block plan is rebuilt from the journal's manifest
+        on resume, so retuning transfer_chunk_bytes (the knob
+        docs/ingest.md tells operators to tune) between a crash and its
+        resume must restore completed blocks, not reject the journal."""
+        df = _frame()
+        path = str(tmp_path / "retune")
+        with chaos.scoped("jobs.journal_write=fatal:every=3:times=1"):
+            with pytest.raises(ChaosFault):
+                run_job(
+                    "map_rows", _fn, df,
+                    job_dir=str(tmp_path), job_id="retune",
+                )
+        old = get_config().transfer_chunk_bytes
+        set_config(transfer_chunk_bytes=64)  # would re-plan 4-row blocks
+        try:
+            res = resume_job(path, _fn, df)
+        finally:
+            set_config(transfer_chunk_bytes=old)
+        assert res.blocks_total == 6  # the journaled 16-row plan held
+        assert res.blocks_restored > 0
+        assert np.array_equal(_col(res.completed), _col(tft.map_rows(_fn, df)))
+
+    def test_plan_aligns_with_transfer_chunks(self, tmp_path):
+        """A journal block never spans transfer chunks: with a 128-byte
+        chunk over 16-byte rows, the plan caps blocks at 8 rows even
+        though the device-call cap allows far more."""
+        old = (
+            get_config().transfer_chunk_bytes,
+            get_config().max_rows_per_device_call,
+        )
+        set_config(transfer_chunk_bytes=128, max_rows_per_device_call=8192)
+        try:
+            df = _frame()  # 96 rows x 4 f32 = 16 B/row
+            res = run_job("map_rows", _fn, df, job_dir=str(tmp_path))
+            assert res.blocks_total == 12  # 96 rows / 8-row chunks
+            assert np.array_equal(
+                _col(res.completed), _col(tft.map_rows(_fn, df))
+            )
+        finally:
+            set_config(
+                transfer_chunk_bytes=old[0],
+                max_rows_per_device_call=old[1],
+            )
+
 
 # ---------------------------------------------------------------------------
 
