@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-chaos test-durability test-multihost verify bench bench-serve bench-jobs bench-ingest bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-chaos test-durability test-fleet test-multihost verify bench bench-serve bench-jobs bench-ingest bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -45,6 +45,12 @@ test-chaos:
 test-durability:
 	$(PY) -m pytest tests/ -q -m durability
 
+# the serving-fleet suite (serve/fleet.py: replicated engines behind the
+# health-gated router, failover + request replay) — the fast tests are
+# tier-1; the multi-replica chaos soak is marked slow and runs here too
+test-fleet:
+	$(PY) -m pytest tests/ -q -m fleet
+
 # just the real 2-process distributed suite
 test-multihost:
 	$(PY) -m pytest tests/test_multihost.py -q
@@ -53,7 +59,9 @@ test-multihost:
 bench:
 	$(PY) bench.py
 
-# serving trajectory: tokens/s + inter-token latency at 1/4/16 concurrency
+# serving trajectory: tokens/s + inter-token latency at 1/4/16 concurrency,
+# plus the fleet's aggregate tokens/s at 1/2/4 replicas
+# (TFT_BENCH_REPLICAS=1,2 shrinks the replicas axis for smoke runs)
 bench-serve:
 	$(PY) bench.py decode_serve
 

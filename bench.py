@@ -404,7 +404,49 @@ def _serve_one_concurrency(lm, n_requests, plen, max_new, seed):
     }
 
 
+def _serve_fleet_aggregate(lm, replicas, n_requests=16, plen=32, max_new=64,
+                           seed=0):
+    """Aggregate fleet throughput at one replica count: ``n_requests``
+    requests placed by the router across ``replicas`` engines, timed
+    end-to-end on the consumer side. Each replica's two step programs
+    compile in an untimed warmup (the persistent compile cache makes
+    replicas 2..N near-free)."""
+    from tensorframes_tpu.serve import Fleet
+
+    rng = np.random.default_rng(seed)
+    prompts = [
+        rng.integers(1, 256, size=plen).astype(np.int32).tolist()
+        for _ in range(n_requests)
+    ]
+    fleet = Fleet(
+        lm,
+        replicas=replicas,
+        max_slots=8,
+        page_size=16,
+        max_seq_len=plen + max_new,
+        queue_capacity=n_requests,
+    )
+    with fleet:
+        warm = [eng.submit([1, 2, 3], 2, block=False) for eng in fleet.engines]
+        for h in warm:
+            h.result(timeout=600)
+        t0 = time.perf_counter()
+        handles = [fleet.submit(p, max_new) for p in prompts]
+        for h in handles:
+            h.result(timeout=600)
+        dt = time.perf_counter() - t0
+        programs = fleet.program_counts()
+    return {
+        "tokens_per_sec": round(n_requests * max_new / dt, 1),
+        "wall_s": round(dt, 3),
+        "requests": n_requests,
+        "compiled_step_programs": programs,
+    }
+
+
 def main_decode_serve():
+    import os
+
     import jax
 
     import tensorframes_tpu as tft
@@ -421,6 +463,18 @@ def main_decode_serve():
             lm, c, plen=plen, max_new=max_new, seed=c
         )
     head = levels["16"]
+    # the scale-out axis: aggregate tokens/s with the serving fleet at
+    # 1/2/4 replicas, same per-request shape, 16 concurrent requests
+    # routed least-loaded (TFT_BENCH_REPLICAS="1,2" shrinks smoke runs;
+    # on a single-chip/CPU host the replicas share the device, so this
+    # measures router + engine overhead there and true scale-out only
+    # with one chip per replica)
+    reps_env = os.environ.get("TFT_BENCH_REPLICAS", "1,2,4")
+    rep_levels = {}
+    for r in [int(x) for x in reps_env.split(",") if x.strip()]:
+        rep_levels[str(r)] = _serve_fleet_aggregate(
+            lm, r, plen=plen, max_new=max_new, seed=100 + r
+        )
     from tensorframes_tpu.utils import chaos
 
     print(
@@ -438,6 +492,7 @@ def main_decode_serve():
                     "model": "d128 h8 L4 vocab256",
                     "device": str(jax.devices()[0]),
                     "concurrency": levels,
+                    "replicas": rep_levels,
                     # a chaos-tainted number must never be mistaken for a
                     # clean one (the injection sites sit on this path; the
                     # disabled check is the measured-as-free case)

@@ -41,13 +41,20 @@ shows up on the ``serving.active_connections`` gauge. See
 ``docs/observability.md``.
 
 Generation: constructed with ``engine=`` (a
-:class:`~tensorframes_tpu.serve.GenerationEngine`), the same port also
-serves ``POST /generate`` — JSON in (``{"prompt": [ids],
-"max_new_tokens": n, "temperature"?, "top_p"?, "seed"?}``), JSON out
-(``{"request_id": ..., "tokens": [ids]}``) — backed by the engine's
-continuous-batching loop, so concurrent connections share one decode
-batch and one page pool (see ``docs/serving_llm.md``). A full admission
-queue answers 503 (backpressure), an infeasible request 400.
+:class:`~tensorframes_tpu.serve.GenerationEngine` or a replicated
+:class:`~tensorframes_tpu.serve.Fleet`), the same port also serves
+``POST /generate`` — JSON in (``{"prompt": [ids],
+"max_new_tokens": n, "temperature"?, "top_p"?, "seed"?, "session"?}``),
+JSON out (``{"request_id": ..., "tokens": [ids]}``) — backed by the
+engine's continuous-batching loop, so concurrent connections share one
+decode batch and one page pool (see ``docs/serving_llm.md``). With a
+fleet, each request is placed on a healthy replica and survives replica
+deaths via request replay; ``"session"`` keys opt into replica affinity.
+A full admission queue answers 503 (backpressure) with an ADAPTIVE
+``Retry-After`` — queue depth × observed p50 inter-token latency,
+clamped to [1, 30] seconds, 1 until latency samples exist — an
+infeasible request 400. Unknown paths get 404; known paths with the
+wrong verb get 405 + ``Allow``.
 """
 
 from __future__ import annotations
@@ -71,8 +78,8 @@ __all__ = ["ScoringServer", "remote_arrow_mapper", "remote_map_in_arrow"]
 
 _m_requests = _counter(
     "serving.requests_total",
-    "Connections served, by kind (score|metrics|generate) and terminal "
-    "status",
+    "Connections served, by kind (score|metrics|healthz|generate|http) "
+    "and terminal status",
     labels=("kind", "status"),
 )
 _m_bytes_in = _counter(
@@ -88,6 +95,29 @@ _m_latency = _histogram(
 _m_active = _gauge(
     "serving.active_connections", "Connections currently being served"
 )
+
+
+def _adaptive_retry_after(engine) -> str:
+    """The 503 ``Retry-After`` value: aggregate queue depth × observed
+    p50 inter-token latency (how long the backlog ahead of a retry
+    plausibly takes to drain one slot), clamped to [1, 30] seconds.
+    Falls back to ``"1"`` while no latency samples exist (cold engine)
+    or anything in the estimate is unavailable — a wrong hint must never
+    break the shed path."""
+    import math
+
+    try:
+        depth = 0
+        if engine is not None:
+            depth = int(engine.health().get("queue_depth", 0) or 0)
+        from ..obs.metrics import registry
+
+        p50 = registry().get("serve.inter_token_seconds").quantile(0.5)
+        if p50 is None:
+            return "1"
+        return str(int(min(30, max(1, math.ceil(depth * p50)))))
+    except Exception:
+        return "1"
 
 
 class _CountingFile:
@@ -261,6 +291,16 @@ class ScoringServer:
     #: stream can never start with these bytes)
     _HTTP_PREFIXES = (b"GET ", b"POST")
 
+    #: the HTTP routing table: path -> verbs it answers. Anything else is
+    #: a crisp 404 (unknown path) or 405 + ``Allow`` (wrong verb) — note
+    #: only GET/POST-prefixed requests reach HTTP handling at all (the
+    #: peek above routes everything else to the Arrow parser)
+    _ROUTES: Dict[str, Tuple[str, ...]] = {
+        "/metrics": ("GET",),
+        "/healthz": ("GET",),
+        "/generate": ("POST",),
+    }
+
     @classmethod
     def _peek(cls, conn: socket.socket) -> bytes:
         """The request's first bytes without consuming them (so the Arrow
@@ -301,14 +341,17 @@ class ScoringServer:
           supervisor marked the engine unhealthy or a stop wedged;
         - ``POST /generate`` (``engine=`` configured) — JSON
           ``{"prompt": [ids], "max_new_tokens": n, "temperature"?,
-          "top_p"?, "seed"?, "deadline_s"?}`` submitted to the
-          continuous-batching engine; responds ``{"request_id",
-          "tokens"}`` when the stream completes. 503 + ``Retry-After``
-          on a full admission queue or an unhealthy engine (shed, don't
-          block), 504 on a missed deadline, 400 on an infeasible
-          request.
+          "top_p"?, "seed"?, "deadline_s"?, "session"?}`` submitted to
+          the continuous-batching engine (or placed by the fleet
+          router); responds ``{"request_id", "tokens"}`` when the
+          stream completes. 503 + adaptive ``Retry-After`` on a full
+          admission queue or an unhealthy engine / all-fenced fleet
+          (shed, don't block), 504 on a missed deadline, 400 on an
+          infeasible request.
 
-        Returns the request kind for the metrics label."""
+        Unknown paths answer 404; known paths with the wrong verb 405
+        with an ``Allow`` header. Returns the request kind for the
+        metrics label."""
         import json
 
         conn.settimeout(10)
@@ -337,24 +380,36 @@ class ScoringServer:
                 break
             body += chunk
 
-        kind = "metrics"
+        kind = "http"
         ctype = "text/plain; charset=utf-8"
         extra_headers: Dict[str, str] = {}
-        if verb == "GET" and path in ("/metrics", "/metrics/"):
+        norm = path.rstrip("/") or "/"
+        allowed = self._ROUTES.get(norm)
+        if allowed is None:
+            # an unknown path is the CLIENT's mistake: say so crisply
+            # instead of falling through to an ambiguous catch-all
+            out = b"endpoints: GET /metrics, GET /healthz, POST /generate\n"
+            status = "404 Not Found"
+        elif verb not in allowed:
+            # right path, wrong verb: 405 with the verbs that would work
+            out = f"method {verb or '?'} not allowed on {norm}\n".encode(
+                "utf-8"
+            )
+            status = "405 Method Not Allowed"
+            extra_headers["Allow"] = ", ".join(allowed)
+        elif norm == "/metrics":
+            kind = "metrics"
             out = _render_prometheus().encode("utf-8")
             status = "200 OK"
             ctype = "text/plain; version=0.0.4; charset=utf-8"
-        elif verb == "GET" and path in ("/healthz", "/healthz/"):
+        elif norm == "/healthz":
             kind = "healthz"
-            status, out = self._handle_healthz()
+            status, out, extra_headers = self._handle_healthz()
             ctype = "application/json; charset=utf-8"
-        elif verb == "POST" and path == "/generate":
+        else:  # /generate, POST
             kind = "generate"
             status, out, extra_headers = self._handle_generate(body)
             ctype = "application/json; charset=utf-8"
-        else:
-            out = b"endpoints: GET /metrics, GET /healthz, POST /generate\n"
-            status = "404 Not Found"
         header_lines = "".join(
             f"{k}: {v}\r\n" for k, v in extra_headers.items()
         )
@@ -370,16 +425,19 @@ class ScoringServer:
         )
         return kind
 
-    def _handle_healthz(self) -> Tuple[str, bytes]:
+    def _handle_healthz(self) -> Tuple[str, bytes, Dict[str, str]]:
         """Liveness for load balancers and the chaos soak: the engine's
         :meth:`~tensorframes_tpu.serve.GenerationEngine.health` snapshot
         (last-step watchdog age, queue depth, pages in use, unhealthy
-        flags), plus this process's batch-job summary
-        (``engine/jobs.py``: active/completed/failed runs, the last
-        job's block counts and quarantine tally) so operators see batch
-        health next to serving health. A server with no engine is just
-        an Arrow scorer — always healthy as long as it accepts
-        connections."""
+        flags) — for a :class:`~tensorframes_tpu.serve.Fleet`, the
+        AGGREGATE with per-replica detail, 200 while any replica serves
+        — plus this process's batch-job summary (``engine/jobs.py``:
+        active/completed/failed runs, the last job's block counts and
+        quarantine tally) so operators see batch health next to serving
+        health. A server with no engine is just an Arrow scorer —
+        always healthy as long as it accepts connections. A 503 carries
+        the adaptive ``Retry-After`` so probes and balancers know when
+        to look again."""
         import json
 
         if self._engine is None:
@@ -392,8 +450,12 @@ class ScoringServer:
             report["jobs"] = jobs_status()
         except Exception:  # health must never 500 over a status probe
             report["jobs"] = None
-        status = "200 OK" if report["healthy"] else "503 Service Unavailable"
-        return status, json.dumps(report).encode("utf-8")
+        body = json.dumps(report).encode("utf-8")
+        if report["healthy"]:
+            return "200 OK", body, {}
+        return "503 Service Unavailable", body, {
+            "Retry-After": _adaptive_retry_after(self._engine)
+        }
 
     def _handle_generate(
         self, body: bytes
@@ -420,28 +482,47 @@ class ScoringServer:
             prompt = spec["prompt"]
             max_new = int(spec["max_new_tokens"])
             deadline = spec.get("deadline_s")
-            deadline = None if deadline is None else float(deadline)
+            kwargs: Dict[str, Any] = dict(
+                temperature=float(spec.get("temperature", 0.0)),
+                top_p=float(spec.get("top_p", 1.0)),
+                seed=int(spec.get("seed", 0)),
+                deadline=None if deadline is None else float(deadline),
+                block=False,
+            )
+            if spec.get("session") is not None:
+                # replica affinity — only the fleet router understands it
+                # (duck-typed on its replica surface; catching TypeError
+                # from submit instead would blame the client for any
+                # internal TypeError bug)
+                if not hasattr(self._engine, "replica_names"):
+                    return "400 Bad Request", json.dumps(
+                        {"error": "session affinity requires a fleet "
+                                  "engine (serve.Fleet)"}
+                    ).encode("utf-8"), {}
+                kwargs["session"] = str(spec["session"])
         except (ValueError, KeyError, TypeError) as e:
             return "400 Bad Request", json.dumps(
                 {"error": f"bad request: {type(e).__name__}: {e}"}
             ).encode("utf-8"), {}
         try:
-            handle = self._engine.submit(
-                prompt,
-                max_new,
-                temperature=float(spec.get("temperature", 0.0)),
-                top_p=float(spec.get("top_p", 1.0)),
-                seed=int(spec.get("seed", 0)),
-                deadline=deadline,
-                block=False,
-            )
+            handle = self._engine.submit(prompt, max_new, **kwargs)
+        except TimeoutError as e:
+            # the fleet router can notice a deadline expiring DURING
+            # placement (DeadlineExceededError) — same 504 as a stream
+            # that expired mid-generation
+            return "504 Gateway Timeout", json.dumps(
+                {"error": str(e)}
+            ).encode("utf-8"), {}
         except (QueueFullError, EngineUnhealthyError) as e:
             # overload shedding: the caller can retry, THIS server can't
             # help right now — answer fast instead of parking the
-            # connection against a full queue or a dead engine
+            # connection against a full queue or a dead engine. The
+            # Retry-After adapts to the backlog (depth x p50 ITL).
             return "503 Service Unavailable", json.dumps(
                 {"error": str(e)}
-            ).encode("utf-8"), {"Retry-After": "1"}
+            ).encode("utf-8"), {
+                "Retry-After": _adaptive_retry_after(self._engine)
+            }
         except ValueError as e:
             return "400 Bad Request", json.dumps(
                 {"error": str(e)}

@@ -270,6 +270,27 @@ class Histogram(_Metric):
         with self._lock:
             return {"counts": list(s[0]), "sum": s[1], "count": s[2]}
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Approximate ``q``-quantile from the fixed buckets: the
+        smallest upper bound whose cumulative count reaches
+        ``q * count`` (observations in the ``+Inf`` tail report the top
+        bound — an UNDERestimate there, which is the conservative
+        direction for the latency-derived hints this feeds). ``None``
+        when the series has no samples. Consumers: the serving 503
+        ``Retry-After`` estimate (``interop/serving.py``)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1]; got {q}")
+        s = self.series(**labels)
+        if not s or not s["count"]:
+            return None
+        target = q * s["count"]
+        cum = 0
+        for bound, cnt in zip(self.bounds, s["counts"]):
+            cum += cnt
+            if cum >= target:
+                return bound
+        return self.bounds[-1]
+
     def _series(self):
         with self._lock:
             return {

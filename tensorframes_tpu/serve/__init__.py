@@ -9,14 +9,20 @@ for the whole lifetime. See ``docs/serving_llm.md``.
 - :mod:`.kv_pages` — the paged KV cache (static pool + page tables)
 - :mod:`.scheduler` — bounded admission, slots, preempt-and-requeue
 - :mod:`.engine` — the compiled prefill/decode steps + streaming API
+- :mod:`.fleet` — N engine replicas behind a health-gated router with
+  least-loaded/session-affinity placement, fencing + background
+  restart, and request replay on replica death
 """
 
 from .engine import EngineUnhealthyError, GenerationEngine
+from .fleet import Fleet, FleetHandle
 from .kv_pages import PagePool, SequencePages, pages_needed
 from .scheduler import GenerationHandle, GenRequest, QueueFullError, Scheduler
 
 __all__ = [
     "EngineUnhealthyError",
+    "Fleet",
+    "FleetHandle",
     "GenerationEngine",
     "GenerationHandle",
     "GenRequest",

@@ -246,6 +246,11 @@ class GenerationEngine:
         #: monotonic time the last step COMPLETED (the /healthz watchdog:
         #: a large age with work queued means the stepping path is wedged)
         self._last_step_t = time.monotonic()
+        #: a fault queued by :meth:`inject_fault` — consumed (and raised)
+        #: at the START of the next step, so an externally-injected
+        #: replica kill lands at a step boundary instead of racing a
+        #: step in progress
+        self._poison: Optional[BaseException] = None
         _m_pages_capacity.set(float(num_pages))
 
     # -- compiled step builders -------------------------------------------
@@ -359,6 +364,7 @@ class GenerationEngine:
         block: bool = True,
         timeout: Optional[float] = None,
         deadline: Optional[float] = None,
+        _handle_factory=None,
     ) -> GenerationHandle:
         """Queue one generation request; returns its streaming handle.
         Raises ``ValueError`` for requests that could never be scheduled,
@@ -368,7 +374,12 @@ class GenerationEngine:
         recover). ``deadline`` is a per-request budget in SECONDS from
         now: the step sweep evicts the request — queued or
         mid-generation — once it passes, and the handle raises
-        :class:`~tensorframes_tpu.utils.failures.DeadlineExceededError`."""
+        :class:`~tensorframes_tpu.utils.failures.DeadlineExceededError`.
+
+        ``_handle_factory`` (private) lets the fleet router
+        (``serve/fleet.py``) substitute its relay handle —
+        ``factory(request_id) -> GenerationHandle`` — so emissions and
+        the terminal close forward to the fleet-level stream."""
         prompt = np.asarray(prompt, np.int32).ravel()
         if prompt.size < 1:
             _m_requests.inc(status="rejected")
@@ -395,7 +406,9 @@ class GenerationEngine:
         with self._submit_lock:
             self._req_counter += 1
             rid = self._req_counter
-        handle = GenerationHandle(rid)
+        handle = (
+            GenerationHandle if _handle_factory is None else _handle_factory
+        )(rid)
         req = GenRequest(
             request_id=rid,
             prompt=prompt,
@@ -445,6 +458,13 @@ class GenerationEngine:
                 self._last_step_t = time.monotonic()
 
     def _step_locked(self) -> bool:
+        poison = self._poison
+        if poison is not None:
+            # an injected hard fault (inject_fault): raise BEFORE touching
+            # the batch so every token already emitted stays consistent —
+            # the supervisor then fails all in-flight handles promptly
+            self._poison = None
+            raise poison
         expired = self.scheduler.expire(time.monotonic())
         if expired:
             _m_deadline_expired.inc(expired)
@@ -659,6 +679,21 @@ class GenerationEngine:
 
     # -- supervision -------------------------------------------------------
 
+    def inject_fault(self, error: BaseException) -> None:
+        """Queue a hard fault for the NEXT step: the stepping loop raises
+        it at the step boundary and the supervisor fails every in-flight
+        handle with it. This is how an external supervisor (the fleet
+        router, ``serve/fleet.py``) kills a replica without racing a
+        step in progress — calling :meth:`_fail_inflight` from another
+        thread would contend with the step lock and could let the doomed
+        engine keep emitting (or, after device-state corruption, emit
+        WRONG bytes) until the contender wins. ``healthy`` flips now so
+        ``submit`` sheds immediately; the drain lands within one step."""
+        self.healthy = False
+        self._poison = error
+        with self.scheduler._lock:
+            self.scheduler._lock.notify_all()  # wake an idle stepping loop
+
     def _fail_inflight(self, error: BaseException) -> None:
         """The fail-fast path: close EVERY in-flight handle (active slots
         and the whole admission queue) with the real error, NOW, and mark
@@ -700,6 +735,7 @@ class GenerationEngine:
                 self.scheduler.preempt(idx)
             self.pool.reset()
             self._consecutive_ooms = 0
+            self._poison = None  # a queued kill is moot on rebuilt state
             self.healthy = True
             self._last_step_t = time.monotonic()
         _m_restarts.inc()

@@ -1,0 +1,1042 @@
+"""Serving fleet: replicated engines behind a health-gated router.
+
+Everything below ``serve/`` so far protects exactly ONE
+:class:`~.engine.GenerationEngine`: the supervisor retries/degrades/
+restarts it, but a terminal engine failure still fails every in-flight
+request, and max throughput is one chip. The fleet is the next tier —
+the deployment shape TPU serving work assumes (Ragged Paged Attention,
+PAPERS.md): **N identical paged-KV engines behind one placement layer**,
+where a replica death becomes a retried request, not an outage.
+
+- :class:`Fleet` owns N replicas (same model/config, independent
+  :class:`~.kv_pages.PagePool`\\ s) plus the router. :meth:`Fleet.submit`
+  places each request on a healthy replica by **least-loaded** order
+  (most free KV pages, then shallowest admission queue) with optional
+  **session affinity** (``session=`` pins a chat/tenant to one replica's
+  KV locality while it stays healthy).
+- **Health gating** reuses the PR-3 supervisor machinery per replica: a
+  watchdog thread polls ``engine.health()``; an unhealthy or wedged
+  replica is **fenced** (no new placements), drained (every attached
+  handle fails now, so its survivors replay immediately), ``restart()``\\ ed
+  in the background, and re-admitted only after a **probe generation**
+  (one token through prefill AND decode) succeeds.
+- **Request replay** is the robustness core: the router records each
+  request's prompt/params and forwards tokens through a relay, so when a
+  replica dies mid-stream the survivors resubmit to a healthy replica
+  *recompute-style* — already-emitted tokens fold into the prompt and
+  the budget shrinks, the same trick the scheduler's preemption uses.
+  Client streams never replay or lose tokens, and stay **byte-identical**
+  to a solo decode for greedy and seeded-sampling requests alike
+  (per-step sampling keys fold at absolute positions, so the replayed
+  continuation draws the same tokens the dead replica would have).
+
+What does NOT replay: :class:`DeadlineExceededError` (the budget already
+passed) and submit-time ``ValueError`` rejections (every replica is
+identical, so an infeasible request is infeasible everywhere). Replays
+are capped at ``max_replays`` per request so one poison request that
+deterministically kills its replica cannot churn the whole fleet
+forever. Static shapes mean failover adds **zero compiled programs**:
+every replica keeps its own ≤ 2 step programs for the fleet's lifetime.
+
+Chaos sites (``utils/chaos.py``): ``fleet.place`` sits in the placement
+path (a ``transient`` there retries invisibly); ``fleet.replica_fault``
+is polled once per replica per watchdog tick and **kills the replica
+whose poll fired** — append the replica name to target one
+(``fleet.replica_fault.r1=fatal:every=8`` kills ``r1`` on the 8th tick).
+
+``interop/serving.py`` accepts ``engine=Fleet`` unchanged: ``POST
+/generate`` places through the router, ``GET /healthz`` aggregates
+(200 while ANY replica serves; per-replica detail in the body), and
+503-shedding starts only when ALL replicas are fenced. Metrics:
+``fleet.replicas_healthy``, ``fleet.failovers_total``,
+``fleet.replays_total``, and per-replica pages/queue gauges with a
+``replica`` label (``docs/observability.md``). Sizing guidance and the
+failover cookbook: ``docs/serving_llm.md`` + ``docs/fault_tolerance.md``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs.metrics import counter as _counter, gauge as _gauge
+from ..utils import chaos as _chaos
+from ..utils.config import get_config
+from ..utils.failures import DeadlineExceededError, run_with_retries
+from ..utils.logging import get_logger
+from .engine import EngineUnhealthyError, GenerationEngine
+from .scheduler import GenerationHandle, QueueFullError
+
+__all__ = ["Fleet", "FleetHandle"]
+
+logger = get_logger("serve.fleet")
+
+_m_replicas_healthy = _gauge(
+    "fleet.replicas_healthy",
+    "Replicas currently accepting placements (active and healthy)",
+)
+_m_failovers = _counter(
+    "fleet.failovers_total",
+    "Replicas fenced by the router (death, failed health, or wedge): "
+    "the replica was gated out and drained; any survivors it carried "
+    "replay elsewhere (fleet.replays_total counts those)",
+)
+_m_replays = _counter(
+    "fleet.replays_total",
+    "Requests resubmitted to another replica after a replica death "
+    "(recompute-style: emitted tokens folded into the prompt)",
+)
+_m_rep_pages = _gauge(
+    "fleet.replica_pages_in_use",
+    "KV pages owned by live sequences, per replica",
+    labels=("replica",),
+)
+_m_rep_queue = _gauge(
+    "fleet.replica_queue_depth",
+    "Admission-queue depth, per replica",
+    labels=("replica",),
+)
+_m_placements = _counter(
+    "fleet.placements_total",
+    "Requests placed by the router, by chosen replica",
+    labels=("replica",),
+)
+
+#: session-affinity map bound: beyond this many distinct sessions the
+#: oldest mapping is forgotten (affinity is an optimization, not a
+#: correctness property — a forgotten session just re-places least-loaded)
+_MAX_SESSIONS = 4096
+
+
+class FleetHandle(GenerationHandle):
+    """The caller's end of one FLEET request: the same streaming surface
+    as :class:`~.scheduler.GenerationHandle` (iterate for tokens,
+    :meth:`result` for the full generation), fed by the router's relay —
+    tokens keep flowing across replica failovers, and the stream is
+    byte-identical to a solo decode whether zero or several replicas
+    died underneath it."""
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        # idempotent: a late engine-side close (e.g. fleet stop racing a
+        # replica's own shutdown sweep) must not clobber the first result
+        if self._done.is_set():
+            return
+        super()._finish(error)
+
+
+class _FleetRequest:
+    """The router's replay record for one request: everything needed to
+    resubmit it recompute-style, plus the live relay identity."""
+
+    __slots__ = (
+        "request_id", "prompt", "max_new_tokens", "temperature", "top_p",
+        "seed", "eos_id", "deadline_t", "session", "handle", "replica",
+        "inner", "replays", "last_error", "lock", "parked_t",
+    )
+
+    def __init__(
+        self,
+        request_id: int,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        temperature: float,
+        top_p: float,
+        seed: int,
+        eos_id: Optional[int],
+        deadline_t: Optional[float],
+        session: Optional[str],
+        handle: FleetHandle,
+    ):
+        self.request_id = request_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_p = top_p
+        self.seed = seed
+        self.eos_id = eos_id
+        self.deadline_t = deadline_t
+        self.session = session
+        self.handle = handle
+        self.replica: Optional["_Replica"] = None
+        self.inner: Optional["_RelayHandle"] = None
+        self.replays = 0
+        self.last_error: Optional[BaseException] = None
+        #: serializes the relay identity gate against detach+snapshot in
+        #: ``_submit_to`` — without it, a wedged replica's thread could
+        #: pass the gate, stall, and forward its token AFTER the replay
+        #: snapshot (a duplicated position on the client stream)
+        self.lock = threading.Lock()
+        #: monotonic time this record entered the failover queue (reset
+        #: each death); bounds how long a survivor may wait for a
+        #: healthy replica before failing fail-fast-style
+        self.parked_t: Optional[float] = None
+
+
+class _RelayHandle(GenerationHandle):
+    """The engine-side handle the router submits on a request's behalf:
+    emissions forward to the caller's :class:`FleetHandle`, and the
+    terminal close reports back to the fleet so a replica death turns
+    into a replay instead of a failed stream. Forwarding is gated on
+    relay IDENTITY (``rec.inner is self``) so a stale relay — a wedged
+    replica waking up after its request was already replayed — cannot
+    corrupt the stream with duplicate tokens or a stale close."""
+
+    def __init__(self, request_id: int, fleet: "Fleet", rec: _FleetRequest):
+        super().__init__(request_id)
+        self._fleet = fleet
+        self._rec = rec
+        with rec.lock:
+            rec.inner = self
+
+    def _emit(self, token: int) -> None:
+        super()._emit(token)
+        # gate check and forward under the record lock: a bare
+        # check-then-forward could pass the gate, stall, and deliver
+        # after a replay snapshot — the duplicated-position corruption
+        # the gate exists to prevent
+        with self._rec.lock:
+            if self._rec.inner is self:
+                self._rec.handle._emit(token)
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        super()._finish(error)
+        self._fleet._on_inner_finish(self._rec, self, error)
+
+
+class _StreamComplete(Exception):
+    """Raised by ``_submit_to`` when the locked snapshot shows the
+    stream already delivered its whole budget (or its EOS): there is
+    nothing left to resubmit — the caller settles the handle as
+    SUCCESS. Internal control flow, never caller-visible."""
+
+
+class _Replica:
+    """One engine plus its gate state. ``active`` replicas accept
+    placements; ``fenced`` ones are draining/restarting. ``wedged``
+    marks a fence whose stepping thread never exited (a stuck device
+    call) — auto-restart skips those, since ``restart()`` would block on
+    the lock the wedged step still holds; recycle the process."""
+
+    __slots__ = ("name", "engine", "state", "wedged", "restarting", "lock")
+
+    def __init__(self, name: str, engine: GenerationEngine):
+        self.name = name
+        self.engine = engine
+        self.state = "active"
+        self.wedged = False
+        self.restarting = False
+        self.lock = threading.Lock()
+
+
+class Fleet:
+    """N :class:`GenerationEngine` replicas behind one admission router.
+
+    >>> fleet = Fleet(lm, replicas=3, max_slots=8, page_size=16)
+    >>> with fleet:                      # engines + watchdog threads
+    ...     h = fleet.submit(prompt_ids, max_new_tokens=64, session="u1")
+    ...     for tok in h: ...            # survives replica deaths
+    >>> fleet.generate([p1, p2], 32)     # convenience, like the engine's
+
+    Engine-construction keywords (``max_slots``, ``page_size``,
+    ``num_pages``, ``max_seq_len``, ``queue_capacity``, ``top_k``,
+    ``eos_id``, ``moe_top_k``) apply to every replica — identical
+    replicas are what make replay byte-identical. Fleet knobs:
+
+    - ``watchdog_interval_s`` — health-poll + failover-drain cadence;
+    - ``wedge_timeout_s`` — last-step watchdog age (with work pending)
+      past which a live-but-stuck replica is fenced;
+    - ``probe_timeout_s`` — how long a restarted replica's probe
+      generation may take before re-admission is abandoned (retried on
+      a later poll);
+    - ``max_replays`` — per-request failover budget (a poison request
+      that deterministically kills replicas is failed, not bounced
+      forever);
+    - ``failover_timeout_s`` — how long a survivor of a replica death
+      may wait parked for a healthy replica (every replica fenced,
+      restarts failing) before its handle fails with the replica's
+      error — the fleet's version of the fail-fast rule that a doomed
+      stream's consumer must never hang to its own timeout;
+    - ``auto_restart`` — False leaves fenced replicas down until a
+      caller restarts + probes them (``restart_replica()``).
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        replicas: int = 2,
+        watchdog_interval_s: float = 0.05,
+        wedge_timeout_s: float = 30.0,
+        probe_timeout_s: float = 30.0,
+        max_replays: int = 8,
+        failover_timeout_s: float = 60.0,
+        auto_restart: bool = True,
+        **engine_kwargs,
+    ):
+        if replicas < 1:
+            raise ValueError(f"need replicas >= 1; got {replicas}")
+        self._replicas: List[_Replica] = [
+            _Replica(f"r{i}", GenerationEngine(model, **engine_kwargs))
+            for i in range(int(replicas))
+        ]
+        self.watchdog_interval_s = float(watchdog_interval_s)
+        self.wedge_timeout_s = float(wedge_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.max_replays = int(max_replays)
+        self.failover_timeout_s = float(failover_timeout_s)
+        self.auto_restart = bool(auto_restart)
+        self._lock = threading.Lock()
+        self._id_lock = threading.Lock()
+        self._req_counter = 0
+        self._inflight: Dict[int, _FleetRequest] = {}
+        self._pending: Deque[_FleetRequest] = deque()
+        self._sessions: "OrderedDict[str, _Replica]" = OrderedDict()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._wake = threading.Event()
+        self._closed = False
+        _m_replicas_healthy.set(float(len(self._replicas)))
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def engines(self) -> List[GenerationEngine]:
+        """The replica engines, placement order (benches warm each one)."""
+        return [rep.engine for rep in self._replicas]
+
+    @property
+    def replica_names(self) -> List[str]:
+        return [rep.name for rep in self._replicas]
+
+    def replica_state(self, name: str) -> str:
+        return self._replica(name).state
+
+    def _replica(self, name: str) -> _Replica:
+        for rep in self._replicas:
+            if rep.name == name:
+                return rep
+        raise KeyError(f"no replica named {name!r}")
+
+    def program_counts(self) -> Dict[str, int]:
+        """Compiled step programs per replica — the soak pins every value
+        at <= 2 (failover, fencing, restart, and probe are all
+        shape-static)."""
+        return {
+            rep.name: rep.engine.num_step_programs for rep in self._replicas
+        }
+
+    def health(self) -> Dict[str, object]:
+        """Aggregate liveness for ``GET /healthz``: 200-worthy while ANY
+        replica serves, with per-replica detail (each replica's engine
+        snapshot plus its gate state) for operators and the soak."""
+        reps: Dict[str, object] = {}
+        healthy = 0
+        queue_depth = active = pages = cap = 0
+        for rep in self._replicas:
+            h = rep.engine.health()
+            h["state"] = rep.state
+            h["wedged"] = rep.wedged
+            reps[rep.name] = h
+            if rep.state == "active" and h["healthy"]:
+                healthy += 1
+            queue_depth += h["queue_depth"]
+            active += h["active_slots"]
+            pages += h["pages_in_use"]
+            cap += h["pages_capacity"]
+        return {
+            "healthy": healthy > 0,
+            "replicas_total": len(self._replicas),
+            "replicas_healthy": healthy,
+            "queue_depth": queue_depth,
+            "active_slots": active,
+            "pages_in_use": pages,
+            "pages_capacity": cap,
+            "inflight_requests": len(self._inflight),
+            "replicas": reps,
+        }
+
+    # -- placement ---------------------------------------------------------
+
+    def _candidates(self, session: Optional[str] = None) -> List[_Replica]:
+        """Active, healthy replicas in placement-preference order:
+        session-affine replica first (when mapped and still eligible),
+        then least-loaded — most free KV pages, then shallowest queue,
+        then name (a deterministic tiebreak). Raises
+        :class:`EngineUnhealthyError` when every replica is fenced —
+        the ALL-replicas-down shed the endpoint maps to 503."""
+        _chaos.site("fleet.place")
+        cands = [
+            rep
+            for rep in self._replicas
+            if rep.state == "active"
+            and rep.engine.healthy
+            and not rep.engine._stop_wedged
+        ]
+        if not cands:
+            raise EngineUnhealthyError(
+                "all fleet replicas are fenced or unhealthy; the watchdog "
+                "is restarting them — retry shortly"
+            )
+        cands.sort(
+            key=lambda rep: (
+                -rep.engine.pool.pages_free,
+                rep.engine.scheduler.queue_depth,
+                rep.name,
+            )
+        )
+        if session is not None:
+            with self._lock:
+                sticky = self._sessions.get(session)
+                if sticky is not None:
+                    self._sessions.move_to_end(session)
+            if sticky is not None and sticky in cands:
+                cands.remove(sticky)
+                cands.insert(0, sticky)
+        return cands
+
+    def _remember_session(self, session: str, rep: _Replica) -> None:
+        with self._lock:
+            self._sessions[session] = rep
+            self._sessions.move_to_end(session)
+            while len(self._sessions) > _MAX_SESSIONS:
+                self._sessions.popitem(last=False)
+
+    def _submit_to(self, rep: _Replica, rec: _FleetRequest) -> None:
+        """One engine submission for ``rec`` on ``rep``, recompute-style:
+        whatever the stream already delivered folds into the prompt and
+        shrinks the budget, so the replica prefills ``prompt + emitted``
+        and the relay emits only NEW tokens."""
+        deadline = None
+        if rec.deadline_t is not None:
+            deadline = rec.deadline_t - time.monotonic()
+            if deadline <= 0:
+                raise DeadlineExceededError(
+                    f"request {rec.request_id} exceeded its deadline "
+                    f"before placement"
+                )
+        # detach any previous relay and snapshot progress ATOMICALLY
+        # (rec.lock pairs with the gate in _RelayHandle._emit): a wedged
+        # replica waking up after the snapshot must find the gate
+        # closed, or its late emission would both reach the client and
+        # be regenerated by the replay (a duplicated position)
+        with rec.lock:
+            rec.inner = None
+            emitted = list(rec.handle._tokens)
+        # the AUTHORITATIVE completeness check, on the locked snapshot: a
+        # wedged replica's final emission can land after any earlier
+        # lock-free check, leaving nothing to resubmit (max_new would be
+        # 0) — or an EOS the replay must not generate past
+        remaining = rec.max_new_tokens - len(emitted)
+        eos = rec.eos_id if rec.eos_id is not None else rep.engine.eos_id
+        if remaining <= 0 or (
+            eos is not None and emitted and emitted[-1] == eos
+        ):
+            raise _StreamComplete()
+        prompt = (
+            np.concatenate([rec.prompt, np.asarray(emitted, np.int32)])
+            if emitted
+            else rec.prompt
+        )
+        rep.engine.submit(
+            prompt,
+            remaining,
+            temperature=rec.temperature,
+            top_p=rec.top_p,
+            seed=rec.seed,
+            eos_id=rec.eos_id,
+            block=False,
+            deadline=deadline,
+            _handle_factory=lambda rid: _RelayHandle(rid, self, rec),
+        )
+        rec.replica = rep
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        eos_id: Optional[int] = None,
+        block: bool = True,
+        timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
+        session: Optional[str] = None,
+    ) -> FleetHandle:
+        """Place one request on a healthy replica; returns its streaming
+        handle. Raises ``ValueError`` for infeasible requests (every
+        replica is identical — rejected everywhere),
+        :class:`QueueFullError` when every active replica's admission
+        queue is full (``block=True`` waits up to ``timeout`` for room),
+        and :class:`EngineUnhealthyError` when ALL replicas are fenced
+        (the endpoint's 503). ``session`` pins subsequent requests with
+        the same key to one replica while it stays healthy."""
+        if self._closed and self._thread is None:
+            raise EngineUnhealthyError("fleet is stopped")
+        if deadline is not None and deadline <= 0:
+            # same client-error classification as GenerationEngine.submit
+            # (a 400, not a 504-shaped DeadlineExceededError from the
+            # placement path)
+            raise ValueError(
+                f"deadline must be positive seconds from now; got {deadline}"
+            )
+        if int(max_new_tokens) < 1:
+            # validated here too (not just per-engine) so the placement
+            # path can rely on a fresh record never being "complete"
+            raise ValueError(
+                f"max_new_tokens must be >= 1; got {max_new_tokens}"
+            )
+        prompt = np.asarray(prompt, np.int32).ravel()
+        with self._id_lock:
+            self._req_counter += 1
+            rid = self._req_counter
+        rec = _FleetRequest(
+            rid,
+            prompt,
+            int(max_new_tokens),
+            float(temperature),
+            float(top_p),
+            int(seed),
+            eos_id,
+            None if deadline is None else time.monotonic() + float(deadline),
+            session,
+            FleetHandle(rid),
+        )
+        t_end = None if timeout is None else time.monotonic() + timeout
+        while True:
+            cands = run_with_retries(
+                lambda: self._candidates(session), what="fleet.place"
+            )
+            exhausted = None
+            for rep in cands:
+                try:
+                    self._submit_to(rep, rec)
+                except QueueFullError as e:
+                    exhausted = e
+                    continue
+                except EngineUnhealthyError:
+                    continue  # raced a death this tick; try the next
+                with self._lock:
+                    # stop() may have closed the fleet between the entry
+                    # guard and placement; registering now would hand
+                    # back a handle nothing will ever step or fail
+                    if self._closed:
+                        rec.handle._finish(
+                            RuntimeError(
+                                "fleet stopped with the request in flight"
+                            )
+                        )
+                        raise EngineUnhealthyError("fleet is stopped")
+                    # a request can settle terminally (instant deadline
+                    # sweep, replica death) before this registration —
+                    # inserting after _terminal's pop would leak the
+                    # record forever, so check under the same lock
+                    if not rec.handle.done:
+                        self._inflight[rid] = rec
+                if session is not None:
+                    self._remember_session(session, rep)
+                _m_placements.inc(replica=rep.name)
+                return rec.handle
+            if exhausted is None:
+                # every candidate raced into a death mid-attempt (no
+                # queue was actually full): re-resolve — the next
+                # _candidates() sees their unhealthy flags and either
+                # finds survivors or sheds EngineUnhealthyError, the
+                # honest signal for "fleet down", not QueueFullError
+                continue
+            if not block:
+                raise QueueFullError(
+                    f"admission queues of all {len(cands)} active "
+                    f"replica(s) are full"
+                ) from exhausted
+            if t_end is not None and time.monotonic() >= t_end:
+                raise QueueFullError(
+                    f"admission queues still full after {timeout}s"
+                ) from exhausted
+            # bounded poll rather than a cross-engine condition: this
+            # path only spins while EVERY replica's queue is full (total
+            # saturation), and queue drains happen inside N independent
+            # engine locks that have no shared signal to wait on
+            time.sleep(0.005)
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: int,
+        **kw,
+    ) -> List[np.ndarray]:
+        """Submit every prompt, wait for completion, return each
+        request's generated tokens — the fleet twin of
+        :meth:`GenerationEngine.generate`. Starts the fleet for the call
+        when it is not already running."""
+        started_here = self._thread is None
+        if started_here:
+            self.start()
+        try:
+            handles = [self.submit(p, max_new_tokens, **kw) for p in prompts]
+            timeout = get_config().serve_result_timeout_s
+            return [h.result(timeout=timeout) for h in handles]
+        finally:
+            if started_here:
+                self.stop()
+
+    # -- failover ----------------------------------------------------------
+
+    @staticmethod
+    def _replayable(error: BaseException) -> bool:
+        """Replica deaths replay; the request's own terminal conditions
+        do not: a passed deadline is passed everywhere, and an
+        infeasible request (``ValueError``) is infeasible on every
+        identical replica."""
+        return not isinstance(error, (DeadlineExceededError, ValueError))
+
+    def _on_inner_finish(
+        self,
+        rec: _FleetRequest,
+        inner: "_RelayHandle",
+        error: Optional[BaseException],
+    ) -> None:
+        """A relay closed (engine thread context — keep this cheap and
+        lock-light): success and non-replayable errors settle the
+        caller's handle; replica deaths park the record for the router
+        thread to resubmit."""
+        with rec.lock:
+            if rec.inner is not inner:
+                return  # stale relay from before a replay
+        if error is None:
+            rec.handle._finish(None)
+            with self._lock:
+                self._inflight.pop(rec.request_id, None)
+            return
+        if (
+            self._closed
+            or not self._replayable(error)
+            or rec.replays >= self.max_replays
+        ):
+            if rec.replays >= self.max_replays and self._replayable(error):
+                logger.warning(
+                    "fleet: request %d spent its replay budget (%d); "
+                    "failing it with the replica's error",
+                    rec.request_id,
+                    self.max_replays,
+                )
+            self._terminal(rec, error)
+            return
+        rec.last_error = error
+        rec.parked_t = time.monotonic()
+        with self._lock:
+            self._pending.append(rec)
+        self._wake.set()
+
+    def _terminal(self, rec: _FleetRequest, error: BaseException) -> None:
+        rec.handle._finish(error)
+        with self._lock:
+            self._inflight.pop(rec.request_id, None)
+
+    def _stream_complete(self, rec: _FleetRequest) -> bool:
+        """Whether the stream already delivered everything the request
+        asked for — the full budget, or its (request- or engine-level)
+        EOS token. A replica can die in the window between a relay's
+        final emission and its clean close (the wedged drain path);
+        resubmitting such a request would either be infeasible
+        (``max_new_tokens=0``) or generate PAST the EOS, so the router
+        settles it as success instead."""
+        emitted = rec.handle._tokens
+        if len(emitted) >= rec.max_new_tokens:
+            return True
+        eos = rec.eos_id
+        if eos is None:
+            eos = self._replicas[0].engine.eos_id  # replicas are identical
+        return eos is not None and bool(emitted) and emitted[-1] == eos
+
+    def _replay(self, rec: _FleetRequest) -> bool:
+        """Resubmit one survivor of a replica death. True when settled
+        (placed, terminally failed, or recognized as already complete);
+        False parks it for the next tick (no healthy replica with queue
+        room right now)."""
+        if rec.handle.done:
+            with self._lock:
+                self._inflight.pop(rec.request_id, None)
+            return True
+        if self._stream_complete(rec):
+            rec.handle._finish(None)
+            with self._lock:
+                self._inflight.pop(rec.request_id, None)
+            return True
+        try:
+            cands = run_with_retries(
+                lambda: self._candidates(rec.session), what="fleet.place"
+            )
+        except EngineUnhealthyError:
+            return False  # everything fenced; restarts are in flight
+        except Exception as e:
+            self._terminal(rec, e)
+            return True
+        for rep in cands:
+            try:
+                self._submit_to(rep, rec)
+            except _StreamComplete:
+                # a late (gated) final emission landed after the
+                # lock-free pre-check: the consumer already has every
+                # byte — settle success, nothing to resubmit
+                rec.handle._finish(None)
+                with self._lock:
+                    self._inflight.pop(rec.request_id, None)
+                return True
+            except (QueueFullError, EngineUnhealthyError):
+                continue
+            except Exception as e:
+                self._terminal(rec, e)
+                return True
+            rec.replays += 1
+            _m_replays.inc()
+            logger.warning(
+                "fleet: request %d replayed on replica %s after %s "
+                "(%d emitted token(s) folded into the prompt)",
+                rec.request_id,
+                rep.name,
+                type(rec.last_error).__name__,
+                len(rec.handle._tokens),
+            )
+            return True
+        return False
+
+    def _drain_failovers(self) -> None:
+        with self._lock:
+            batch = list(self._pending)
+            self._pending.clear()
+        parked: List[_FleetRequest] = []
+        now = time.monotonic()
+        # the fail-fast timer below measures time with NO healthy replica
+        # — waiting behind FULL queues on a healthy fleet is ordinary
+        # backpressure, not doom, so presence of healthy capacity resets
+        # the clock instead of failing the survivor with a stale error
+        fleet_has_healthy = any(
+            rep.state == "active"
+            and rep.engine.healthy
+            and not rep.engine._stop_wedged
+            for rep in self._replicas
+        )
+        for rec in batch:
+            if (
+                rec.deadline_t is not None
+                and now >= rec.deadline_t
+                and not rec.handle.done
+            ):
+                self._terminal(
+                    rec,
+                    DeadlineExceededError(
+                        f"request {rec.request_id} exceeded its deadline "
+                        f"awaiting failover"
+                    ),
+                )
+                continue
+            if fleet_has_healthy:
+                rec.parked_t = now
+            elif (
+                rec.parked_t is not None
+                and now - rec.parked_t > self.failover_timeout_s
+                and not rec.handle.done
+            ):
+                # the fail-fast rule, fleet edition: with every replica
+                # fenced and restarts not landing, a deadline-less
+                # consumer must get the replica's real error rather
+                # than hang to its own (or no) timeout
+                logger.warning(
+                    "fleet: request %d waited %.1fs for a healthy "
+                    "replica; failing it with the replica's error",
+                    rec.request_id,
+                    now - rec.parked_t,
+                )
+                self._terminal(
+                    rec,
+                    rec.last_error
+                    or EngineUnhealthyError(
+                        "no healthy replica within the failover timeout"
+                    ),
+                )
+                continue
+            if not self._replay(rec):
+                parked.append(rec)
+        if parked:
+            with self._lock:
+                self._pending.extendleft(reversed(parked))
+
+    # -- health gating -----------------------------------------------------
+
+    def _fence(
+        self, rep: _Replica, error: BaseException, wedged: bool = False
+    ) -> None:
+        """Gate a replica out: no new placements, and every attached
+        handle fails NOW so its survivors hit the failover queue instead
+        of hanging against an engine that will never step them."""
+        with rep.lock:
+            if rep.state != "active":
+                return
+            rep.state = "fenced"
+            rep.wedged = wedged
+        _m_failovers.inc()
+        logger.warning(
+            "fleet: replica %s fenced (%s: %s); draining%s",
+            rep.name,
+            type(error).__name__,
+            str(error).split("\n", 1)[0][:120],
+            "" if wedged else " and restarting in the background",
+        )
+        eng = rep.engine
+        eng.healthy = False  # submit sheds immediately, before the drain
+        try:
+            if wedged:
+                # the wedged step may hold the step lock forever; fail the
+                # handles through the scheduler directly rather than
+                # blocking the watchdog behind a stuck device call
+                eng.scheduler.fail_all(error)
+            elif eng._thread is not None and eng._thread.is_alive():
+                # a live stepping loop drains ITSELF at the next step
+                # boundary — fighting it for the step lock from here
+                # could lose for many steps while the doomed engine
+                # keeps emitting
+                eng.inject_fault(error)
+            else:
+                eng._fail_inflight(error)  # nothing stepping: drain inline
+        except Exception:
+            logger.warning(
+                "fleet: drain of replica %s failed", rep.name, exc_info=True
+            )
+        self._wake.set()
+
+    def _kill_replica(self, rep: _Replica, error: BaseException) -> None:
+        """A chaos-scheduled hard replica fault: the replica dies at its
+        next step boundary (fence + injected fault), then its device
+        state is scrambled outright (like the crash drills in
+        tests/test_chaos.py) — the router must carry every stream
+        without the dead replica's help, and ``restart()`` must not
+        depend on anything the pool still holds."""
+        self._fence(rep, error)
+        eng = rep.engine
+        # scramble only AFTER the injected fault drained at a step
+        # boundary: a step already past the poison check may not have
+        # read pool.k/v yet, and scrambling under it would emit wrong
+        # bytes through the still-open relay before the kill lands
+        drained = time.monotonic() + 2.0
+        while eng._poison is not None and time.monotonic() < drained:
+            time.sleep(0.002)
+        if eng._poison is not None:
+            # a step is stuck past the poison check: scrambling under it
+            # would be the exact corrupt-emission this wait prevents —
+            # the fence (and eventual drain) IS the kill; skip the color
+            logger.warning(
+                "fleet: replica %s kill: injected fault not drained "
+                "after 2s (stuck step?); skipping the pool scramble",
+                rep.name,
+            )
+            return
+        try:
+            eng.pool.k = eng.pool.k * 0.0 + 97.0
+            eng.pool.v = eng.pool.v * 0.0 - 97.0
+        except Exception:
+            pass  # the fence is the fault; corruption is the drill's color
+
+    def restart_replica(self, name: str) -> bool:
+        """Manually restart + probe + re-admit a fenced replica (the
+        ``auto_restart=False`` path). A no-op on an active replica
+        (restarting one that is serving would preempt healthy traffic),
+        on a wedged one (``restart()`` would block behind the stuck
+        step — recycle the process), and while another restart worker
+        already owns the replica. Returns whether the replica is active
+        afterwards."""
+        rep = self._replica(name)
+        with rep.lock:
+            if rep.state != "fenced" or rep.wedged or rep.restarting:
+                return rep.state == "active"
+            rep.restarting = True
+        self._restart_worker(rep)
+        return rep.state == "active"
+
+    def _restart_worker(self, rep: _Replica) -> None:
+        """Background recovery for one fenced replica: ``restart()``
+        rebuilds device state (zero recompiles), then a probe generation
+        must push one token through prefill AND decode before the
+        replica takes traffic again — re-admitting a replica that
+        cannot actually generate would just bounce the survivors."""
+        try:
+            eng = rep.engine
+            # let the fence's injected fault drain the old traffic first:
+            # restarting early would requeue survivors on THIS replica
+            # instead of letting the router replay them, and the probe
+            # would race the pending kill
+            drained = time.monotonic() + 5.0
+            while time.monotonic() < drained and (
+                eng._poison is not None or eng.scheduler.has_work()
+            ):
+                if self._stop_evt.is_set():
+                    return
+                time.sleep(0.005)
+            if self._stop_evt.is_set() or self._closed:
+                # the fleet stopped while this worker waited: restarting
+                # (healthy=True, probe compute) AFTER stop() returned
+                # would resurrect a replica the caller believes is down
+                return
+            try:
+                eng.restart()
+            except RuntimeError:
+                logger.warning(
+                    "fleet: replica %s restart refused (wedged stop?); "
+                    "leaving it fenced",
+                    rep.name,
+                )
+                return
+            probe_new = max(1, min(2, eng.max_seq_len - 1))
+            probe = eng.submit(
+                [1], probe_new, block=False, deadline=self.probe_timeout_s
+            )
+            if eng._thread is None:
+                eng.run_until_idle()  # fleet not started: drive it inline
+            probe.result(timeout=self.probe_timeout_s)
+            if self._stop_evt.is_set() or self._closed:
+                return  # stopped mid-probe: stay fenced, stay quiet
+            with rep.lock:
+                rep.state = "active"
+                rep.wedged = False
+            logger.warning(
+                "fleet: replica %s re-admitted (restart + probe ok)",
+                rep.name,
+            )
+        except Exception:
+            logger.warning(
+                "fleet: replica %s probe failed; it stays fenced for the "
+                "next watchdog attempt",
+                rep.name,
+                exc_info=True,
+            )
+        finally:
+            rep.restarting = False
+
+    def _poll_replicas(self) -> None:
+        healthy = 0
+        for rep in self._replicas:
+            if rep.state == "active":
+                try:
+                    _chaos.site("fleet.replica_fault")
+                    _chaos.site("fleet.replica_fault." + rep.name)
+                except Exception as e:
+                    self._kill_replica(rep, e)
+            h = rep.engine.health()
+            if rep.state == "active":
+                wedged = (
+                    h["last_step_age_s"] > self.wedge_timeout_s
+                    and (h["queue_depth"] > 0 or h["active_slots"] > 0)
+                    and bool(h["stepping_thread_alive"])
+                )
+                if not h["healthy"] or wedged:
+                    self._fence(
+                        rep,
+                        RuntimeError(
+                            "replica health probe failed "
+                            f"(healthy={h['healthy']}, "
+                            f"last_step_age_s={h['last_step_age_s']})"
+                        ),
+                        wedged=wedged,
+                    )
+            if rep.state == "fenced" and not rep.wedged and self.auto_restart:
+                with rep.lock:
+                    # compare-and-set under the replica lock: a manual
+                    # restart_replica() may own the replica already
+                    spawn = rep.state == "fenced" and not rep.restarting
+                    if spawn:
+                        rep.restarting = True
+                if spawn:
+                    threading.Thread(
+                        target=self._restart_worker, args=(rep,), daemon=True
+                    ).start()
+            if rep.state == "active":
+                healthy += 1
+            _m_rep_queue.set(float(h["queue_depth"]), replica=rep.name)
+            _m_rep_pages.set(float(h["pages_in_use"]), replica=rep.name)
+        _m_replicas_healthy.set(float(healthy))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Fleet":
+        """Start every replica's stepping thread plus the fleet's
+        router/watchdog thread. A stopped fleet may start again."""
+        if self._thread is not None:
+            raise RuntimeError("fleet already started")
+        self._closed = False
+        self._stop_evt.clear()
+        self._wake.clear()
+        for rep in self._replicas:
+            if rep.engine._thread is None:
+                rep.engine.start()
+        self._thread = threading.Thread(target=self._supervise, daemon=True)
+        self._thread.start()
+        return self
+
+    def _supervise(self) -> None:
+        """The router thread: fence/restart on health, resubmit the
+        failover queue. Logs loudly if it ever dies — a silent watchdog
+        death would turn the next replica fault back into an outage."""
+        try:
+            while not self._stop_evt.is_set():
+                self._poll_replicas()
+                self._drain_failovers()
+                self._wake.wait(self.watchdog_interval_s)
+                self._wake.clear()
+        except BaseException:
+            if not self._stop_evt.is_set():
+                logger.error(
+                    "fleet supervisor thread died; failover and "
+                    "re-admission are OFFLINE until restart",
+                    exc_info=True,
+                )
+            raise
+
+    def stop(self) -> None:
+        """Stop the router and every replica; any still-open fleet
+        handle fails (never strands its consumer)."""
+        with self._lock:
+            # under the fleet lock so a concurrent submit either
+            # registers BEFORE this flag (and gets drained below) or
+            # observes it at registration and sheds
+            self._closed = True
+        self._stop_evt.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # a zombie router fencing/replaying next to a future
+                # start()'s router would double every failover action —
+                # keep the reference (start() refuses while it is set)
+                # and let a retried stop() join again; _stop_evt stays
+                # set, so the thread exits whenever it unblocks
+                logger.warning(
+                    "fleet: router thread did not stop within 10s "
+                    "(blocked in a drain?); stop() again to retry — "
+                    "start() is refused until it exits"
+                )
+            else:
+                self._thread = None
+        for rep in self._replicas:
+            try:
+                rep.engine.stop()
+            except Exception:
+                logger.warning(
+                    "fleet: replica %s stop failed", rep.name, exc_info=True
+                )
+        with self._lock:
+            recs = list(self._inflight.values())
+            self._inflight.clear()
+            self._pending.clear()
+        err = RuntimeError("fleet stopped with the request in flight")
+        for rec in recs:
+            rec.handle._finish(err)  # no-op on already-settled handles
+
+    def __enter__(self) -> "Fleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

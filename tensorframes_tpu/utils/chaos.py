@@ -25,6 +25,17 @@ Named **injection sites** sit on the host-side dispatch paths:
   chunk's retry window (``frame/transfer.py``): a ``transient`` here is
   the flaky-tunnel-during-ingest drill (one chunk retries; the column
   still lands byte-identical)
+- ``fleet.place`` — inside the serving fleet's placement path
+  (``serve/fleet.py``): a ``transient`` here retries invisibly; a
+  ``fatal`` is the router-bug drill
+- ``fleet.replica_fault`` — polled once per replica per fleet watchdog
+  tick: any raising kind KILLS the replica whose poll fired (device
+  state scrambled, every attached handle failed — the hard-process-
+  fault drill for failover/replay). Suffix the site with a replica
+  name to target one: ``fleet.replica_fault.r1=fatal:every=8`` — this
+  site composes such names at runtime, so its dotted suffixes (its
+  FAMILY, see ``SITE_FAMILIES``) skip the unknown-site warning;
+  suffixes on every other site warn like any typo.
 
 A site is one call: ``chaos.site("serve.decode_step")``. When no
 schedule is configured (the default) that compiles down to a single
@@ -75,7 +86,15 @@ from typing import Dict, List, Optional, Tuple
 
 from .logging import get_logger
 
-__all__ = ["ChaosFault", "SITES", "active_spec", "enabled", "scoped", "site"]
+__all__ = [
+    "ChaosFault",
+    "SITES",
+    "SITE_FAMILIES",
+    "active_spec",
+    "enabled",
+    "scoped",
+    "site",
+]
 
 logger = get_logger("chaos")
 
@@ -107,7 +126,16 @@ SITES = (
     "jobs.journal_write",
     "frame.h2d",
     "frame.d2h",
+    "fleet.place",
+    "fleet.replica_fault",
 )
+
+#: sites whose code COMPOSES dotted suffixes at runtime (their FAMILY):
+#: ``fleet.replica_fault.<name>`` targets one replica. Only these skip
+#: the unknown-site warning for suffixed names — a suffix on any other
+#: wired site (``serve.decode_step.typo=...``) is still a typo that
+#: would silently never fire, and must warn
+SITE_FAMILIES = ("fleet.replica_fault",)
 
 _KINDS = ("transient", "oom", "pool", "latency", "fatal")
 
@@ -228,7 +256,13 @@ def _refresh() -> None:
             return
         seed, by_site = _parse(spec)
         for name in by_site:
-            if name not in SITES:
+            # dotted suffixes of a FAMILY site (SITE_FAMILIES — e.g. the
+            # fleet's per-replica kills, fleet.replica_fault.r1) fire
+            # because the code composes those names at runtime; suffixes
+            # on any other site are typos and warn like unknown names
+            if name not in SITES and not any(
+                name.startswith(s + ".") for s in SITE_FAMILIES
+            ):
                 # not an error (tests inject at ad-hoc sites), but a
                 # typo'd production schedule silently never firing would
                 # defeat the harness — say so once at configure time

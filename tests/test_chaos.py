@@ -197,6 +197,88 @@ class TestHarness:
         assert "ENV_OK" in out.stdout, out.stderr
 
 
+class TestSiteDrift:
+    def test_every_package_call_site_is_declared_in_SITES(self):
+        """Drift regression: a chaos site added to the package without a
+        SITES entry would silently miss the configure-time unknown-site
+        warning — a typo'd schedule for it would never fire and nobody
+        would be told. Grep the package for literal
+        ``chaos.site("...")`` call sites and assert both directions:
+        every referenced name is declared, and every declared name has a
+        call site (dead entries lie about coverage). Dynamically
+        composed names (``"site." + suffix``, e.g. the fleet's
+        per-replica kills) are out of grep scope by design — they ride
+        a declared site's family."""
+        import re
+        from pathlib import Path
+
+        import tensorframes_tpu
+
+        root = Path(tensorframes_tpu.__file__).parent
+        # every call form in the package: chaos.site("..."),
+        # _chaos.site("..."), and the `site as _chaos_site` import alias
+        pat = re.compile(
+            r"""(?:_chaos\.site|chaos\.site|_chaos_site)"""
+            r"""\(\s*["']([^"']+)["']\s*\)"""
+        )
+        referenced = {}
+        sources = {}
+        for path in sorted(root.rglob("*.py")):
+            text = path.read_text()
+            sources[path.name] = text
+            for m in pat.finditer(text):
+                referenced.setdefault(m.group(1), set()).add(path.name)
+        assert referenced, "grep found no chaos.site call sites at all"
+        unknown = {
+            name: sorted(files)
+            for name, files in referenced.items()
+            if name not in chaos.SITES
+        }
+        assert not unknown, (
+            f"chaos.site() call sites missing from chaos.SITES: {unknown} "
+            f"— add them so a typo'd schedule warns at configure time"
+        )
+        # converse, softer (composed names like `"frame." + direction`
+        # defeat the call-site grep): every declared site must at least
+        # be MENTIONED in package source — a SITES entry nothing
+        # references is a lie about coverage
+        dead = [
+            s
+            for s in chaos.SITES
+            if s not in referenced
+            and not any(s in text for text in sources.values())
+        ]
+        assert not dead, (
+            f"chaos.SITES entries never referenced in the package: {dead}"
+        )
+
+    def test_site_family_suffix_skips_unknown_site_warning(self, caplog):
+        """``fleet.replica_fault.r1``-style names are a FAMILY site's
+        runtime-composed children (``SITE_FAMILIES``): configuring one
+        must not warn. A suffix on a NON-family site and a genuinely
+        unknown name must both still warn — they are typos that would
+        silently never fire."""
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="tensorframes_tpu.chaos"):
+            with chaos.scoped("fleet.replica_fault.r9=fatal"):
+                pass
+        assert not any(
+            "not one of the wired" in r.getMessage() for r in caplog.records
+        )
+        for typo in ("totally.bogus=fatal", "serve.decode_step.typo=fatal"):
+            caplog.clear()
+            with caplog.at_level(
+                logging.WARNING, logger="tensorframes_tpu.chaos"
+            ):
+                with chaos.scoped(typo):
+                    pass
+            assert any(
+                "not one of the wired" in r.getMessage()
+                for r in caplog.records
+            ), typo
+
+
 class TestEngineDispatchSite:
     def test_batch_engine_retries_injected_transients(self, fast_retries):
         import tensorframes_tpu as tft

@@ -482,6 +482,80 @@ class TestSupervisor:
         assert eng.health()["healthy"] is True
 
 
+class TestRestartSubmitRace:
+    def test_submits_racing_restart_shed_or_complete_never_hang(self, lm):
+        """restart() racing concurrent submit() on one engine: every
+        submit must either shed fast with ``EngineUnhealthyError`` (or
+        fail with the crash's own error, when a crash preceded the
+        restart) or complete BYTE-IDENTICALLY — and no accepted handle
+        may hang past its timeout. Phase 1 races restarts against a
+        healthy engine (restart preempts-and-requeues, so nothing may
+        shed or fail); phase 2 interleaves crashes, where shedding is
+        the correct outcome for unlucky submits."""
+        eng = GenerationEngine(lm, max_slots=3, page_size=4, max_seq_len=32)
+        accepted = []  # (prompt, handle), under hlock
+        sheds = []
+        hlock = threading.Lock()
+        stop = threading.Event()
+        crash_allowed = threading.Event()
+
+        def submitter(tid):
+            trng = np.random.default_rng(300 + tid)
+            while not stop.is_set():
+                p = trng.integers(
+                    1, VOCAB, size=int(trng.integers(2, 6))
+                ).tolist()
+                try:
+                    h = eng.submit(p, 4)
+                except EngineUnhealthyError:
+                    with hlock:
+                        sheds.append(tid)
+                    assert crash_allowed.is_set(), (
+                        "submit shed while only healthy restarts were "
+                        "racing it"
+                    )
+                    time.sleep(0.002)
+                    continue
+                with hlock:
+                    accepted.append((p, h))
+                time.sleep(0.005)
+
+        with eng:
+            threads = [
+                threading.Thread(target=submitter, args=(i,))
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            # phase 1: pure restarts — legal mid-run, streams must not
+            # notice and submits must not shed
+            for _ in range(5):
+                time.sleep(0.03)
+                eng.restart()
+            # phase 2: crash + restart — submits may now shed, accepted
+            # handles may fail with the injected crash
+            crash_allowed.set()
+            for _ in range(5):
+                time.sleep(0.03)
+                eng._fail_inflight(RuntimeError("injected crash"))
+                time.sleep(0.005)
+                eng.restart()
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert not any(t.is_alive() for t in threads)
+            # every accepted handle settles — byte-identical or failed
+            # with the crash — well inside the timeout (TimeoutError
+            # here would be the hang this test exists to catch)
+            for p, h in accepted:
+                try:
+                    toks = h.result(timeout=60)
+                except RuntimeError:
+                    continue  # crashed mid-flight in phase 2 — legal
+                np.testing.assert_array_equal(toks, _solo(lm, p, 4))
+        assert accepted, "the race never accepted a submit"
+
+
 class TestDeadlines:
     def test_queued_request_expires(self, lm):
         eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
