@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-chaos test-durability test-fleet test-multihost verify bench bench-serve bench-jobs bench-ingest bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-durability test-fleet test-multihost verify bench bench-serve bench-attn bench-jobs bench-ingest bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -33,6 +33,12 @@ test-fast:
 # PR) — run this before shipping so local numbers match CI's
 verify:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# the paged-attention suite (ops ragged kernel vs the gather oracle,
+# prefix cache, chunked prefill) — fast, CPU interpret mode, part of
+# tier-1; run alone when iterating on the kernel or the cache
+test-attn:
+	$(PY) -m pytest tests/ -q -m attn
 
 # the seeded fault-injection suite (utils/chaos.py + the serving
 # supervisor under chaos) — fast, CPU-only, deterministic; part of
@@ -64,6 +70,12 @@ bench:
 # (TFT_BENCH_REPLICAS=1,2 shrinks the replicas axis for smoke runs)
 bench-serve:
 	$(PY) bench.py decode_serve
+
+# decode paged-KV read microbench: gather vs the fused ragged
+# paged-attention kernel — GB/s + tokens/s, one JSON line
+# (TFT_BENCH_ATTN_SLOTS / _PAGES / _PAGE_SIZE shape the batch)
+bench-attn:
+	$(PY) bench.py paged_attn
 
 # durable-job overhead: map_rows with the journal on vs off (one JSON line)
 bench-jobs:

@@ -28,10 +28,18 @@ this machine — a stand-in for the reference's CPU execution path.
 Prints exactly one JSON line.
 
 ``python bench.py decode_serve`` instead benchmarks the continuous-
-batching generation engine (``tensorframes_tpu/serve``): tokens/sec and
-p50/p99 INTER-TOKEN latency at 1, 4 and 16 concurrent requests — the
-serving trajectory the ROADMAP's heavy-traffic target is measured by.
-Also exactly one JSON line.
+batching generation engine (``tensorframes_tpu/serve``): tokens/sec,
+p50/p99 INTER-TOKEN latency and p50/p99 TIME-TO-FIRST-TOKEN at 1, 4 and
+16 concurrent requests, a prompt-length axis (``TFT_BENCH_PROMPT_LENS``),
+the gather-vs-fused decode-read axis, and a shared-prefix workload with
+the prefix cache off vs on (hit rate included) — the serving trajectory
+the ROADMAP's heavy-traffic target is measured by. Also exactly one
+JSON line.
+
+``python bench.py paged_attn`` (``make bench-attn``) microbenches the
+decode paged-KV read alone: gather ``paged_attention`` vs the fused
+``ragged_paged_attention`` kernel on one ragged batch — GB/s and
+tokens/s per impl, one JSON line.
 
 ``python bench.py ingest`` (``make bench-ingest``) benchmarks the
 streaming transfer layer (``tensorframes_tpu/frame/transfer.py``):
@@ -343,27 +351,39 @@ def main():
     )
 
 
-def _serve_one_concurrency(lm, n_requests, plen, max_new, seed):
+def _pct(xs, p):
+    return xs[min(len(xs) - 1, int(p * (len(xs) - 1)))] if xs else None
+
+
+def _serve_one_concurrency(
+    lm, n_requests, plen, max_new, seed, prompts=None, **engine_kw
+):
     """One timed serving run: ``n_requests`` streams decoded through one
     shared continuous batch. Token timestamps are taken on the consumer
     side (per-stream iterators on their own threads), so the measured
-    inter-token gaps include the full engine path — scheduling, the
-    compiled step, host sync, and handle delivery."""
+    inter-token gaps AND time-to-first-token include the full engine
+    path — scheduling, the compiled step(s), host sync, and handle
+    delivery. ``prompts`` overrides the random per-request prompts (the
+    shared-prefix workload passes near-identical ones); ``engine_kw``
+    passes through to ``GenerationEngine`` (attention_impl,
+    prefix_cache, prefill_chunk_tokens...)."""
     import threading
 
     from tensorframes_tpu.serve import GenerationEngine
 
     rng = np.random.default_rng(seed)
-    prompts = [
-        rng.integers(1, 256, size=plen).astype(np.int32).tolist()
-        for _ in range(n_requests)
-    ]
+    if prompts is None:
+        prompts = [
+            rng.integers(1, 256, size=plen).astype(np.int32).tolist()
+            for _ in range(n_requests)
+        ]
     eng = GenerationEngine(
         lm,
         max_slots=n_requests,
         page_size=16,
         max_seq_len=plen + max_new,
         queue_capacity=n_requests,
+        **engine_kw,
     )
     # warmup: compile prefill + decode outside the timed window
     eng.generate([prompts[0]], 2)
@@ -389,19 +409,24 @@ def _serve_one_concurrency(lm, n_requests, plen, max_new, seed):
     gaps = sorted(
         b - a for s in stamps for a, b in zip(s, s[1:])
     )
-    ttfts = [s[0] - t0 for s in stamps if s]
-
-    def pct(xs, p):
-        return xs[min(len(xs) - 1, int(p * (len(xs) - 1)))] if xs else None
-
-    return {
+    ttfts = sorted(s[0] - t0 for s in stamps if s)
+    out = {
         "tokens_per_sec": round(total / dt, 1),
-        "itl_p50_ms": round(pct(gaps, 0.50) * 1e3, 3),
-        "itl_p99_ms": round(pct(gaps, 0.99) * 1e3, 3),
+        "itl_p50_ms": round(_pct(gaps, 0.50) * 1e3, 3),
+        "itl_p99_ms": round(_pct(gaps, 0.99) * 1e3, 3),
+        "ttft_p50_ms": round(_pct(ttfts, 0.50) * 1e3, 3),
+        "ttft_p99_ms": round(_pct(ttfts, 0.99) * 1e3, 3),
         "ttft_max_ms": round(max(ttfts) * 1e3, 3),
         "wall_s": round(dt, 3),
         "compiled_step_programs": eng.num_step_programs,
     }
+    if eng.prefix_cache is not None:
+        st = eng.prefix_cache.stats()
+        out["prefix_cache_hit_rate"] = round(
+            st["hits"] / max(1, st["lookups"]), 3
+        )
+        out["prefix_cache_tokens_saved"] = st["tokens_saved"]
+    return out
 
 
 def _serve_fleet_aggregate(lm, replicas, n_requests=16, plen=32, max_new=64,
@@ -454,7 +479,7 @@ def main_decode_serve():
 
     tft.enable_compilation_cache()
     lm = TransformerLM.init(
-        0, 256, d_model=128, n_heads=8, n_layers=4, max_len=256
+        0, 256, d_model=128, n_heads=8, n_layers=4, max_len=512
     )
     plen, max_new = 32, 64
     levels = {}
@@ -463,6 +488,54 @@ def main_decode_serve():
             lm, c, plen=plen, max_new=max_new, seed=c
         )
     head = levels["16"]
+    # prompt-length axis at concurrency 16: TTFT and tokens/s vs prompt
+    # size (TFT_BENCH_PROMPT_LENS trims/extends; lens + max_new must fit
+    # the model's 512-position table)
+    lens_env = os.environ.get("TFT_BENCH_PROMPT_LENS", "32,128,384")
+    prompt_lens = {}
+    for pl in [int(x) for x in lens_env.split(",") if x.strip()]:
+        prompt_lens[str(pl)] = _serve_one_concurrency(
+            lm, 16, plen=pl, max_new=max_new, seed=1000 + pl
+        )
+    # decode-read implementation axis: the gather reference vs the fused
+    # ragged paged-attention kernel (the fused win is a TPU bandwidth
+    # property; on a CPU host the kernel runs in interpret mode — the
+    # axis shrinks there so the smoke run stays minutes, and the number
+    # only means something on real hardware)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    attn_c, attn_new = (16, max_new) if on_tpu else (4, 16)
+    attention = {}
+    for impl in ("gather", "fused"):
+        attention[impl] = _serve_one_concurrency(
+            lm, attn_c, plen=plen, max_new=attn_new, seed=42,
+            attention_impl=impl,
+        )
+    # shared-prefix workload: 16 requests sharing a 448-token system
+    # prompt + 16 distinct user tokens, prefix cache off vs on (with
+    # chunked prefill sized near the uncached suffix, so a hit prefills
+    # one 32-wide chunk instead of the 464-token prompt) — the TTFT-
+    # reduction acceptance axis. The warmup request inside
+    # _serve_one_concurrency registers the prefix, so the timed window
+    # measures the steady state (system prompt already resident).
+    rng = np.random.default_rng(9)
+    sys_prompt = rng.integers(1, 256, size=448).astype(np.int32).tolist()
+    shared_prompts = [
+        sys_prompt
+        + rng.integers(1, 256, size=16).astype(np.int32).tolist()
+        for _ in range(16)
+    ]
+    shared_prefix = {}
+    for label, kw in (
+        ("cache_off", {}),
+        (
+            "cache_on",
+            {"prefix_cache": True, "prefill_chunk_tokens": 32},
+        ),
+    ):
+        shared_prefix[label] = _serve_one_concurrency(
+            lm, 16, plen=464, max_new=32, seed=9,
+            prompts=shared_prompts, **kw
+        )
     # the scale-out axis: aggregate tokens/s with the serving fleet at
     # 1/2/4 replicas, same per-request shape, 16 concurrent requests
     # routed least-loaded (TFT_BENCH_REPLICAS="1,2" shrinks smoke runs;
@@ -492,11 +565,119 @@ def main_decode_serve():
                     "model": "d128 h8 L4 vocab256",
                     "device": str(jax.devices()[0]),
                     "concurrency": levels,
+                    "prompt_lens": prompt_lens,
+                    "attention_impl": attention,
+                    "shared_prefix": shared_prefix,
                     "replicas": rep_levels,
                     # a chaos-tainted number must never be mistaken for a
                     # clean one (the injection sites sit on this path; the
                     # disabled check is the measured-as-free case)
                     "chaos": chaos.active_spec() or "off",
+                },
+            }
+        )
+    )
+
+
+def main_paged_attn():
+    """Decode paged-read microbench (``make bench-attn``): the gather
+    ``paged_attention`` vs the fused ``ragged_paged_attention`` kernel on
+    one ragged decode batch, outside the engine — isolating the read
+    that PR-7 fuses. Reports per-impl step latency, decode tokens/s
+    (slots / step), and two bandwidth views: ``gb_per_s_touched`` (bytes
+    that impl actually reads: the gather touches ``max_pages *
+    page_size`` positions per slot, the fused kernel only live pages)
+    and ``gb_per_s_live`` (live-KV bytes / time — the apples-to-apples
+    throughput number; higher is better). Exactly one JSON line.
+
+    Knobs: ``TFT_BENCH_ATTN_SLOTS`` (default 16),
+    ``TFT_BENCH_ATTN_PAGES`` (max pages/slot, default 32),
+    ``TFT_BENCH_ATTN_PAGE_SIZE`` (default 16). Lengths are ragged:
+    slot i holds ``(i + 1) / slots`` of the max length."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.ops import paged_attention, ragged_paged_attention
+
+    tft.enable_compilation_cache()
+    slots = int(os.environ.get("TFT_BENCH_ATTN_SLOTS", "16"))
+    mp = int(os.environ.get("TFT_BENCH_ATTN_PAGES", "32"))
+    ps = int(os.environ.get("TFT_BENCH_ATTN_PAGE_SIZE", "16"))
+    n_kv, group, hd = 8, 1, 128
+    pool_pages = slots * mp
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(
+        rng.normal(size=(slots, n_kv, group, hd)).astype(np.float32)
+    )
+    kp = jnp.asarray(
+        rng.normal(size=(pool_pages + 1, ps, n_kv, hd)).astype(np.float32)
+    )
+    vp = jnp.asarray(
+        rng.normal(size=(pool_pages + 1, ps, n_kv, hd)).astype(np.float32)
+    )
+    ptab = (
+        np.arange(slots * mp, dtype=np.int32).reshape(slots, mp) % pool_pages
+    )
+    lengths = np.maximum(
+        1, ((np.arange(slots) + 1) * mp * ps) // slots
+    ).astype(np.int32)
+    live_pages = int(sum(-(-int(l) // ps) for l in lengths))
+    bytes_per_page = ps * n_kv * hd * 4 * 2  # k and v
+    live_bytes = live_pages * bytes_per_page
+    touched = {
+        "gather": slots * mp * bytes_per_page,
+        "fused": live_bytes,
+    }
+
+    impls = {
+        "gather": jax.jit(paged_attention),
+        "fused": jax.jit(ragged_paged_attention),
+    }
+    # off-TPU the fused kernel runs in interpret mode (~1000x slower, a
+    # correctness vehicle, not a measurement) — keep the smoke run short
+    iters = 20 if jax.devices()[0].platform == "tpu" else 3
+    out = {}
+    for name, fn in impls.items():
+        jax.block_until_ready(fn(q, kp, vp, ptab, lengths))  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(q, kp, vp, ptab, lengths)
+        jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / iters
+        out[name] = {
+            "step_ms": round(dt * 1e3, 4),
+            "tokens_per_sec": round(slots / dt, 1),
+            "gb_per_s_touched": round(touched[name] / dt / 1e9, 3),
+            "gb_per_s_live": round(live_bytes / dt / 1e9, 3),
+        }
+    print(
+        json.dumps(
+            {
+                "metric": "paged_attn_fused_tokens_per_sec",
+                "value": out["fused"]["tokens_per_sec"],
+                "unit": "tok/s",
+                "vs_baseline": round(
+                    out["fused"]["tokens_per_sec"]
+                    / out["gather"]["tokens_per_sec"],
+                    3,
+                ),
+                "detail": {
+                    "workload": (
+                        f"single decode-step paged KV read, {slots} slots, "
+                        f"ragged lengths up to {mp * ps} positions, "
+                        f"page_size {ps}, n_kv {n_kv}, head_dim {hd}, f32"
+                    ),
+                    "device": str(jax.devices()[0]),
+                    "live_kv_gb": round(live_bytes / 1e9, 4),
+                    "impl": out,
+                    "note": (
+                        "fused wins are a TPU bandwidth property; on a "
+                        "CPU host the fused number measures pallas "
+                        "interpret-mode overhead, not the kernel"
+                    ),
                 },
             }
         )
@@ -717,6 +898,8 @@ if __name__ == "__main__":
 
     if len(sys.argv) > 1 and sys.argv[1] == "decode_serve":
         main_decode_serve()
+    elif len(sys.argv) > 1 and sys.argv[1] == "paged_attn":
+        main_paged_attn()
     elif len(sys.argv) > 1 and sys.argv[1] == "map_rows":
         main_map_rows_journal()
     elif len(sys.argv) > 1 and sys.argv[1] == "ingest":

@@ -67,7 +67,12 @@ _coder_tried = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, _SRC_EXEC, "-o", _LIB]
+    # -std=c++17 explicitly: the sources use std::string_view, and
+    # toolchains older than gcc 11 still default to gnu++14
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, _SRC_EXEC, "-o", _LIB,
+    ]
     try:
         res = subprocess.run(
             cmd, capture_output=True, text=True, timeout=120
@@ -175,7 +180,7 @@ def _load_coder():
             need_build = not os.path.exists(_LIB_CODER)
         if need_build:
             cmd = [
-                "g++", "-O3", "-shared", "-fPIC", "-pthread",
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
                 f"-I{sysconfig.get_paths()['include']}",
                 _SRC_CODER, "-o", _LIB_CODER,
             ]

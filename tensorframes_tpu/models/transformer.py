@@ -26,6 +26,7 @@ __all__ = [
     "transformer_generate",
     "transformer_step",
     "transformer_prefill",
+    "transformer_prefill_chunk",
     "transformer_loss",
     "token_nll",
     "TransformerLM",
@@ -467,6 +468,64 @@ def transformer_prefill(params, tokens, moe_top_k: int = 1):
             )
     logits = _ln(h, params["ln_f"]) @ embed.T
     return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def transformer_prefill_chunk(params, tokens, positions, attend,
+                              moe_top_k: int = 1):
+    """One CHUNK of a prompt through the block walk, with attention
+    delegated — the mid-sequence sibling of :func:`transformer_step`
+    (single token, cache owned by the caller) and
+    :func:`transformer_prefill` (whole prompt, dense causal, cache
+    returned). Chunked prefill needs a third shape: a ``[B, C]`` span of
+    tokens at arbitrary ``positions``, attending to cache the caller
+    already holds (earlier chunks, or a shared-prefix hit) PLUS itself
+    causally.
+
+    ``tokens`` [B, C] int32; ``positions`` [C] int32 (absolute; the
+    caller clips padding positions in-bounds). ``attend(li, q, k, v) ->
+    [B, C, d_model]``: q ``[B, C, n_kv, group, hd]`` (grouped-query
+    layout), this chunk's k/v ``[B, C, n_kv, hd]`` — the callback
+    scatters k/v wherever it keeps its cache and reads the visible
+    history under its own causal mask. The per-row math (LN, MLP,
+    residuals, head split) is token-local and identical to
+    :func:`transformer_prefill`'s, so a prompt prefilled in chunks
+    produces byte-identical k/v and logits to one dense pass. Returns
+    logits ``[B, C, vocab]``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.moe import moe_ffn
+
+    tokens = jnp.asarray(tokens, dtype=jnp.int32)
+    bsz, clen = tokens.shape
+    n_heads = params["n_heads"]
+    embed = jnp.asarray(params["embed"])
+    posemb = jnp.asarray(params["pos"])
+    d_model = embed.shape[1]
+    hd = d_model // n_heads
+    h = embed[tokens] + posemb[positions][None]
+    for li, block in enumerate(params["blocks"]):
+        n_kv = _kv_heads(block, d_model, n_heads)
+        group = n_heads // n_kv
+        kv_d = n_kv * hd
+        x = _ln(h, block["ln1"])
+        qkv = x @ jnp.asarray(block["qkv"])
+        q, k, v = jnp.split(qkv, [d_model, d_model + kv_d], axis=-1)
+        att = attend(
+            li,
+            q.reshape(bsz, clen, n_kv, group, hd),
+            k.reshape(bsz, clen, n_kv, hd),
+            v.reshape(bsz, clen, n_kv, hd),
+        )
+        h = h + att @ jnp.asarray(block["proj"])
+        hx = _ln(h, block["ln2"])
+        if "moe" in block:
+            h = h + moe_ffn(block["moe"], hx, k=moe_top_k)
+        else:
+            h = h + jax.nn.gelu(hx @ jnp.asarray(block["up"])) @ (
+                jnp.asarray(block["down"])
+            )
+    return _ln(h, params["ln_f"]) @ embed.T
 
 
 def transformer_generate(

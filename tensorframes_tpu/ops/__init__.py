@@ -6,6 +6,8 @@ from .attention import (
     flash_attention,
     online_block_update,
     paged_attention,
+    paged_page_size_hint,
+    ragged_paged_attention,
 )
 from .ring import ring_attention, ring_attention_sharded
 from .ulysses import ulysses_attention, ulysses_attention_sharded
@@ -14,6 +16,8 @@ __all__ = [
     "flash_attention",
     "attention_reference",
     "paged_attention",
+    "ragged_paged_attention",
+    "paged_page_size_hint",
     "online_block_update",
     "ring_attention",
     "ring_attention_sharded",

@@ -29,6 +29,8 @@ __all__ = [
     "flash_attention",
     "attention_reference",
     "paged_attention",
+    "ragged_paged_attention",
+    "paged_page_size_hint",
     "online_block_update",
     "flash_carry",
     "flash_bwd_pair",
@@ -199,6 +201,58 @@ def attention_reference(
     )
 
 
+def _check_paged_inputs(q, k_pages, v_pages, page_table, lengths):
+    """Shared validation for the paged decode reads (gather and fused).
+
+    The position mask is ``arange(T) < lengths`` and the gather indexes
+    with ``page_table`` directly, so a wrong dtype does not fail — it
+    silently miscomputes (a float ``lengths`` compares almost-equal, an
+    int64 table under x64 re-traces to a different layout). Serving
+    correctness rides on these being right, so reject loudly at trace
+    time instead."""
+    if np.ndim(q) != 4:
+        raise ValueError(
+            f"q must be [slots, n_kv, group, head_dim]; got shape "
+            f"{np.shape(q)}"
+        )
+    slots, n_kv, _, hd = np.shape(q)
+    for name, arr in (("k_pages", k_pages), ("v_pages", v_pages)):
+        if np.ndim(arr) != 4:
+            raise ValueError(
+                f"{name} must be [pool_pages, page_size, n_kv, head_dim]; "
+                f"got shape {np.shape(arr)}"
+            )
+    if np.shape(k_pages) != np.shape(v_pages):
+        raise ValueError(
+            f"k_pages and v_pages must share a shape; got "
+            f"{np.shape(k_pages)} vs {np.shape(v_pages)}"
+        )
+    if np.shape(k_pages)[2] != n_kv or np.shape(k_pages)[3] != hd:
+        raise ValueError(
+            f"page pool holds (n_kv={np.shape(k_pages)[2]}, "
+            f"head_dim={np.shape(k_pages)[3]}) but q asks for "
+            f"(n_kv={n_kv}, head_dim={hd})"
+        )
+    if np.ndim(page_table) != 2 or np.shape(page_table)[0] != slots:
+        raise ValueError(
+            f"page_table must be [slots={slots}, max_pages]; got shape "
+            f"{np.shape(page_table)}"
+        )
+    if np.shape(lengths) != (slots,):
+        raise ValueError(
+            f"lengths must be [slots={slots}]; got shape "
+            f"{np.shape(lengths)}"
+        )
+    for name, arr in (("page_table", page_table), ("lengths", lengths)):
+        dt = np.dtype(getattr(arr, "dtype", None) or np.asarray(arr).dtype)
+        if dt != np.dtype(np.int32):
+            raise ValueError(
+                f"{name} must be int32 (got {dt}): the position mask and "
+                f"the page gather consume it as-is, and a wrong dtype "
+                f"miscomputes silently — cast with .astype(np.int32)"
+            )
+
+
 def paged_attention(q, k_pages, v_pages, page_table, lengths):
     """Single-token attention read over a PAGED KV cache — the decode-side
     gather for the serving engine (:mod:`tensorframes_tpu.serve`), where
@@ -222,7 +276,14 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths):
     family matches the dense decode-cache read in
     ``models.transformer.transformer_generate`` (same contraction axes,
     same mask value), so paged and dense decode agree to float
-    associativity. Returns [S, n_kv, group, hd]."""
+    associativity. Returns [S, n_kv, group, hd].
+
+    This is the REFERENCE formulation: it materializes two
+    ``[S, max_pages * page_size, n_kv, hd]`` gathered copies per call, so
+    a ragged batch pays max-length bandwidth for every slot.
+    :func:`ragged_paged_attention` is the fused kernel that walks the
+    page table in-kernel instead; this gather stays as its oracle."""
+    _check_paged_inputs(q, k_pages, v_pages, page_table, lengths)
     slots, n_kv, group, hd = q.shape
     mp = page_table.shape[1]
     ps = k_pages.shape[1]
@@ -236,6 +297,166 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths):
     visible = jnp.arange(t)[None, :] < lengths[:, None]  # [S, T]
     s = jnp.where(visible[:, None, None, :], s, _NEG_BIG)
     return jnp.einsum("bkgt,btkd->bkgd", jax.nn.softmax(s, axis=-1), vg)
+
+
+def paged_page_size_hint(dtype, head_dim: int) -> int:
+    """The measured-best key-tile width for the fused paged read, from
+    the flash sweep's ``_BEST_BLOCKS``: the ragged kernel's key tile IS
+    one page (page indirection makes multi-page tiles non-contiguous in
+    the pool, so the tile cannot grow past a page), which makes
+    ``page_size`` the paged analog of ``block_k``. Pools sized with this
+    page size run the kernel at the sweep's best key tile; smaller pages
+    trade kernel efficiency for finer allocation granularity (the usual
+    serving default of 16 leans all the way toward granularity)."""
+    return _best_blocks(dtype, head_dim, 0)[1]
+
+
+def _ragged_paged_kernel(
+    ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, page_size, scale,
+):
+    """Grid = (slots, n_kv_heads, max_pages); the page axis is innermost
+    and sequential, so the VMEM scratch carries the online-softmax state
+    (``online_block_update`` — the same recurrence the flash kernel and
+    the ring step fold with) across a slot's pages. One grid step streams
+    ONE page's [page_size, hd] k/v tiles through the carry: the page
+    table is a scalar-prefetch input, so the BlockSpec index maps chase
+    the indirection and only this slot's OWN pages cross HBM->VMEM — no
+    [slots, max_pages * page_size] gather is ever materialized.
+
+    Pages at or past ``lengths[s]`` are skipped entirely (``pl.when``),
+    so a 1-token sequence in a ragged batch does one page of work while
+    its max-length neighbor does them all — compute scales with LIVE
+    tokens. (Their table entries point at the trash page, so the
+    prefetch pipeline still fetches a page-sized tile, but always the
+    same hot one.) The boundary page masks ``position >= length`` to
+    ``_NEG_BIG`` before the update, exactly like the gather oracle."""
+    from jax.experimental import pallas as pl
+
+    si = pl.program_id(0)
+    pi = pl.program_id(2)
+    npg = pl.num_programs(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[si]
+    base = pi * page_size
+    group = q_ref.shape[2]
+
+    def update(with_mask):
+        q = q_ref[0, 0]        # [group, hd]
+        kj = k_ref[0, :, 0, :]  # [page_size, hd]
+        vj = v_ref[0, :, 0, :]
+        mask = None
+        if with_mask:
+            pos = base + jax.lax.broadcasted_iota(
+                jnp.int32, (group, page_size), 1
+            )
+            mask = pos < length
+        m, l, acc = online_block_update(
+            q, kj, vj, m_scr[:], l_scr[:], acc_scr[:], scale, mask
+        )
+        m_scr[:] = m
+        l_scr[:] = l
+        acc_scr[:] = acc
+
+    # three regimes per page, mirroring the flash kernel's causal tiles:
+    # fully past the sequence (skip — the ragged win), fully visible
+    # interior (no mask work), and the boundary page (masked)
+    interior = base + page_size <= length
+    boundary = jnp.logical_and(base < length, jnp.logical_not(interior))
+
+    @pl.when(interior)
+    def _():
+        update(with_mask=False)
+
+    @pl.when(boundary)
+    def _():
+        update(with_mask=True)
+
+    @pl.when(pi == npg - 1)
+    def _emit():
+        o_ref[0, 0] = _finalize(l_scr[:], acc_scr[:]).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(
+    q, k_pages, v_pages, page_table, lengths, interpret: Optional[bool] = None
+):
+    """Fused single-token paged-attention read: the Pallas kernel that
+    replaces :func:`paged_attention`'s gather for the serving decode step
+    (Ragged Paged Attention, PAPERS.md arXiv:2604.15464).
+
+    Same contract as the gather oracle — ``q`` [S, n_kv, group, hd],
+    ``k_pages``/``v_pages`` [pool_pages, page_size, n_kv, hd],
+    ``page_table`` [S, max_pages] int32, ``lengths`` [S] int32 (valid
+    positions INCLUDING the token just written) — and agrees with it to
+    float tolerance (online softmax vs one-shot softmax associativity).
+    Returns [S, n_kv, group, hd] in ``q``'s dtype.
+
+    Why it wins: the gather reads ``max_pages * page_size`` positions
+    per slot regardless of the slot's real length; this kernel walks
+    each slot's page table in-kernel with scalar prefetch and stops the
+    COMPUTE at the slot's boundary page, so a ragged batch's bandwidth
+    and FLOPs scale with live tokens. The key tile is one page (see
+    :func:`paged_page_size_hint` for the measured-best width); the
+    online-softmax carry is the flash kernel's own recurrence
+    (:func:`online_block_update`), held in VMEM scratch across the
+    sequential page axis. Shapes are static, so the serving engine's
+    no-recompile property is untouched. ``interpret`` defaults to True
+    off-TPU so tests run on CPU."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _check_paged_inputs(q, k_pages, v_pages, page_table, lengths)
+    slots, n_kv, group, hd = q.shape
+    mp = page_table.shape[1]
+    ps = k_pages.shape[1]
+    scale = 1.0 / float(np.sqrt(hd))
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    kernel = functools.partial(
+        _ragged_paged_kernel, page_size=ps, scale=scale
+    )
+    # index maps receive the scalar-prefetch refs after the grid indices:
+    # the k/v maps dereference the page table, so the pipeline fetches
+    # exactly the pages the table names, in table (= position) order
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, n_kv, mp),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, group, hd),
+                lambda s, h, p, ptab, lens: (s, h, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, ps, 1, hd),
+                lambda s, h, p, ptab, lens: (ptab[s, p], 0, h, 0),
+            ),
+            pl.BlockSpec(
+                (1, ps, 1, hd),
+                lambda s, h, p, ptab, lens: (ptab[s, p], 0, h, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, hd), lambda s, h, p, ptab, lens: (s, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, n_kv, group, hd), q.dtype),
+        compiler_params=_dim_semantics(pltpu, interpret),
+        interpret=interpret,
+    )(page_table, lengths, q, k_pages, v_pages)
 
 
 def _flash_kernel(

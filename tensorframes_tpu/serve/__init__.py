@@ -3,10 +3,13 @@
 The subsystem that turns the single-shot decode path
 (:func:`~tensorframes_tpu.models.transformer_generate`) into a service:
 requests with independent arrival times and lengths share one decode
-batch and one static page pool, with exactly two compiled step programs
-for the whole lifetime. See ``docs/serving_llm.md``.
+batch and one static page pool, with at most three compiled step
+programs for the whole lifetime (prefill + decode, plus the
+prefill-chunk program when chunked prefill / prefix-cache resume is in
+play). See ``docs/serving_llm.md``.
 
-- :mod:`.kv_pages` — the paged KV cache (static pool + page tables)
+- :mod:`.kv_pages` — the paged KV cache (static pool + page tables,
+  refcounted pages + the shared-prefix :class:`PrefixCache`)
 - :mod:`.scheduler` — bounded admission, slots, preempt-and-requeue
 - :mod:`.engine` — the compiled prefill/decode steps + streaming API
 - :mod:`.fleet` — N engine replicas behind a health-gated router with
@@ -16,7 +19,7 @@ for the whole lifetime. See ``docs/serving_llm.md``.
 
 from .engine import EngineUnhealthyError, GenerationEngine
 from .fleet import Fleet, FleetHandle
-from .kv_pages import PagePool, SequencePages, pages_needed
+from .kv_pages import PagePool, PrefixCache, SequencePages, pages_needed
 from .scheduler import GenerationHandle, GenRequest, QueueFullError, Scheduler
 
 __all__ = [
@@ -27,6 +30,7 @@ __all__ = [
     "GenerationHandle",
     "GenRequest",
     "PagePool",
+    "PrefixCache",
     "QueueFullError",
     "Scheduler",
     "SequencePages",

@@ -3,7 +3,8 @@
 The serving counterpart of
 :func:`~tensorframes_tpu.models.transformer_generate`: where that
 function compiles one scan program per (batch shape, decode structure),
-this engine compiles exactly TWO programs for a whole serving lifetime —
+this engine compiles at most THREE programs for a whole serving
+lifetime —
 
 - **prefill** ``[1, max_seq_len]``: one right-padded prompt through the
   batched causal pass (:func:`~tensorframes_tpu.models.transformer_prefill`),
@@ -11,8 +12,16 @@ this engine compiles exactly TWO programs for a whole serving lifetime —
   sampled from the last real position's logits;
 - **decode** ``[max_slots]``: one token per occupied slot through the
   shared per-token step (:func:`~tensorframes_tpu.models.transformer_step`)
-  with attention delegated to the paged read
-  (:func:`~tensorframes_tpu.ops.paged_attention`).
+  with attention delegated to the paged read —
+  :func:`~tensorframes_tpu.ops.paged_attention` (gather reference) or
+  :func:`~tensorframes_tpu.ops.ragged_paged_attention` (the fused
+  Pallas kernel, ``attention_impl="fused"``);
+- **prefill-chunk** ``[1, chunk]`` (dispatched only when chunked
+  prefill or a shared-prefix cache hit needs it): one mid-prompt span
+  through :func:`~tensorframes_tpu.models.transformer_prefill_chunk`,
+  attending to the pages already written — long prompts prefill one
+  chunk per step, interleaved with decode, and prefix-cache hits resume
+  after the cached span.
 
 Every input shape is static (page tables are fixed-width, idle slots
 point at the trash page), so slot turnover, ragged lengths, and
@@ -55,6 +64,7 @@ from ..models.transformer import (
     _kv_heads,
     filter_logits,
     transformer_prefill,
+    transformer_prefill_chunk,
     transformer_step,
 )
 from ..obs import span as _span
@@ -72,7 +82,7 @@ from ..utils.failures import (
     run_with_retries,
 )
 from ..utils.logging import get_logger
-from .kv_pages import PagePool, pages_needed
+from .kv_pages import PagePool, PrefixCache, pages_needed
 from .scheduler import (
     GenerationHandle,
     GenRequest,
@@ -129,6 +139,29 @@ _m_handles_failed = _counter(
     "Generation handles closed with an error, by classified reason",
     labels=("reason",),
 )
+_m_prefix_lookups = _counter(
+    "serve.prefix_cache_lookups_total",
+    "Admissions that consulted the shared-prefix KV cache",
+)
+_m_prefix_hits = _counter(
+    "serve.prefix_cache_hits_total",
+    "Admissions whose prompt prefix was served from cached KV pages "
+    "(the prefill skipped the shared span)",
+)
+_m_prefix_tokens_saved = _counter(
+    "serve.prefix_cache_tokens_saved_total",
+    "Prompt positions whose prefill was skipped via cached KV pages",
+)
+_m_pages_shared = _gauge(
+    "serve.kv_pages_shared",
+    "KV pages currently named by more than one reference (prefix-cache "
+    "dedup across sequences)",
+)
+_m_prefill_chunks = _counter(
+    "serve.prefill_chunks_total",
+    "Prefill chunks dispatched (chunked prefill and prefix-cache "
+    "resume both count)",
+)
 
 
 class EngineUnhealthyError(RuntimeError):
@@ -164,7 +197,26 @@ class GenerationEngine:
     full-length pages for every slot (no preemption pressure); size it
     SMALLER to oversubscribe memory and lean on preempt-and-requeue.
     ``top_k`` is engine-static; temperature / ``top_p`` / seed are
-    per-request."""
+    per-request.
+
+    Perf knobs (``None`` falls back to the matching ``Config`` field;
+    docs/serving_llm.md):
+
+    - ``attention_impl``: ``"gather"`` (reference read,
+      ``ops.paged_attention``) or ``"fused"`` (the ragged
+      paged-attention Pallas kernel — decode bandwidth scales with live
+      tokens in a ragged batch);
+    - ``prefill_chunk_tokens``: > 0 prefills prompts longer than this in
+      chunks of this size, one per step, interleaved with decode — a
+      long prompt no longer stalls the whole batch for its full prefill;
+    - ``prefix_cache``: share identical page-aligned prompt prefixes
+      (system prompts, few-shot templates) as refcounted KV pages with
+      copy-on-write on in-page divergence; repeat prefixes skip their
+      prefill entirely.
+
+    A third compiled program (the ``[1, chunk]`` prefill-chunk step)
+    exists only when chunked prefill or the prefix cache dispatches it:
+    ``num_step_programs`` stays <= 2 with both off, <= 3 otherwise."""
 
     def __init__(
         self,
@@ -178,6 +230,9 @@ class GenerationEngine:
         top_k: int = 0,
         eos_id: Optional[int] = None,
         moe_top_k: int = 1,
+        attention_impl: Optional[str] = None,
+        prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache: Optional[bool] = None,
     ):
         import jax
 
@@ -205,8 +260,35 @@ class GenerationEngine:
             num_pages=num_pages,
             page_size=self.page_size,
         )
+        cfg = get_config()
+        if attention_impl is None:
+            attention_impl = cfg.serve_attention_impl
+        if attention_impl not in ("gather", "fused"):
+            raise ValueError(
+                f"attention_impl must be 'gather' or 'fused'; got "
+                f"{attention_impl!r}"
+            )
+        self.attention_impl = attention_impl
+        if prefill_chunk_tokens is None:
+            prefill_chunk_tokens = cfg.serve_prefill_chunk_tokens
+        if prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0; got "
+                f"{prefill_chunk_tokens}"
+            )
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+        #: the chunk program's STATIC width: the chunk size when chunked
+        #: prefill is on, else the full prompt row (the prefix-cache
+        #: resume path then runs as one "chunk" mid-sequence)
+        self._chunk_c = self.prefill_chunk_tokens or self.max_seq_len
+        if prefix_cache is None:
+            prefix_cache = cfg.serve_prefix_cache
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.pool) if prefix_cache else None
+        )
         self.scheduler = Scheduler(
-            self.pool, self.max_slots, queue_capacity, self.max_seq_len
+            self.pool, self.max_slots, queue_capacity, self.max_seq_len,
+            prefix_cache=self.prefix_cache,
         )
         self.top_k = int(top_k)
         self.eos_id = eos_id
@@ -225,6 +307,13 @@ class GenerationEngine:
         )
         self._decode_jit = jax.jit(
             self._decode_impl(n_heads, moe_top_k), donate_argnums=donate
+        )
+        # built unconditionally (a jit wrapper is free until dispatched);
+        # it only dispatches — and only then counts a program — when
+        # chunked prefill or a prefix-cache resume needs it
+        self._prefill_chunk_jit = jax.jit(
+            self._prefill_chunk_impl(n_heads, moe_top_k),
+            donate_argnums=donate,
         )
         #: distinct (name, abstract input signature) pairs dispatched —
         #: jit keys compiles on exactly this, so its length IS the number
@@ -292,15 +381,92 @@ class GenerationEngine:
 
         return prefill
 
+    def _prefill_chunk_impl(self, n_heads: int, moe_top_k: int):
+        """The third compiled step: one ``[1, C]`` span of a prompt at
+        positions ``start .. start + C``, attending to the pages already
+        written (earlier chunks, or a shared-prefix cache hit) plus
+        itself causally. The per-position math is
+        :func:`transformer_prefill_chunk`'s block walk — byte-identical
+        k/v and logits to the one-pass prefill — and the sampled token
+        mirrors the full program's (folded at the LAST prompt position),
+        so only the final chunk's token is consumed."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.attention import _NEG_BIG
+
+        ps = self.page_size
+        trash = self.pool.trash_page
+        top_k = self.top_k
+        mp = self._max_pages
+        max_len = self.max_seq_len
+
+        def chunk_step(
+            p, kp, vp, chunk, start, valid, total_len, ptab, temp, seed,
+            top_p,
+        ):
+            full = {**p, "n_heads": n_heads}
+            c = chunk.shape[1]
+            offs = jnp.arange(c)
+            pos = start + offs  # absolute positions; tail is padding
+            pos_clipped = jnp.minimum(pos, max_len - 1)
+            state = [kp, vp]
+
+            def attend(li, q, k, v):
+                # scatter this chunk's k/v into its pages (padding rows
+                # land in the trash page), then read the whole visible
+                # history through the page table under the causal mask
+                page = jnp.where(offs < valid, ptab[pos_clipped // ps], trash)
+                off = pos_clipped % ps
+                state[0] = state[0].at[li, page, off].set(k[0])
+                state[1] = state[1].at[li, page, off].set(v[0])
+                n_kv, hd = k.shape[2], k.shape[3]
+                t = mp * ps
+                kg = state[0][li][ptab].reshape(t, n_kv, hd)
+                vg = state[1][li][ptab].reshape(t, n_kv, hd)
+                scale = 1.0 / float(np.sqrt(hd))
+                s = jnp.einsum("ckgd,tkd->ckgt", q[0], kg) * scale
+                visible = jnp.arange(t)[None, :] <= pos[:, None]
+                # the shared mask fill: byte-identity between chunked
+                # and one-pass prefill depends on every paged/dense
+                # read masking with the same value
+                s = jnp.where(visible[:, None, None, :], s, _NEG_BIG)
+                att = jnp.einsum(
+                    "ckgt,tkd->ckgd", jax.nn.softmax(s, axis=-1), vg
+                )
+                return att.reshape(1, c, n_kv * q.shape[3] * hd)
+
+            logits = transformer_prefill_chunk(
+                full, chunk, pos_clipped, attend, moe_top_k=moe_top_k
+            )
+            # the final chunk's last REAL position seeds generation,
+            # exactly as the one-pass prefill samples it (key folded at
+            # the absolute last prompt position)
+            last = logits[0, valid - 1]
+            greedy = jnp.argmax(last, axis=-1)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(seed), total_len - 1
+            )
+            scaled = last[None] / jnp.maximum(
+                jnp.asarray(temp, jnp.float32), 1e-6
+            )
+            filt = filter_logits(scaled, top_k=top_k, top_p=top_p)
+            sampled = jax.random.categorical(key, filt, axis=-1)[0]
+            tok = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+            return state[0], state[1], tok
+
+        return chunk_step
+
     def _decode_impl(self, n_heads: int, moe_top_k: int):
         import jax
         import jax.numpy as jnp
 
-        from ..ops import paged_attention
+        from ..ops import paged_attention, ragged_paged_attention
 
         ps = self.page_size
         d_model = self._d_model
         top_k = self.top_k
+        fused = self.attention_impl == "fused"
 
         def decode(p, kp, vp, toks, positions, ptabs, temps, seeds, top_ps):
             full = {**p, "n_heads": n_heads}
@@ -309,12 +475,15 @@ class GenerationEngine:
 
             def attend(li, q, k, v):
                 # write this token's k/v into its page, then read the
-                # whole visible history through the page table
+                # whole visible history through the page table — via the
+                # materialized gather (reference) or the fused ragged
+                # kernel (bandwidth scales with live tokens)
                 page = ptabs[jnp.arange(slots), positions // ps]
                 off = positions % ps
                 state[0] = state[0].at[li, page, off].set(k)
                 state[1] = state[1].at[li, page, off].set(v)
-                ctx = paged_attention(
+                read = ragged_paged_attention if fused else paged_attention
+                ctx = read(
                     q, state[0][li], state[1][li], ptabs, positions + 1
                 )
                 return ctx.reshape(slots, d_model)
@@ -347,8 +516,9 @@ class GenerationEngine:
     @property
     def num_step_programs(self) -> int:
         """Distinct compiled step programs dispatched so far (jit keys on
-        the abstract input signature; static shapes keep this at <= 2:
-        one prefill + one decode)."""
+        the abstract input signature; static shapes keep this at <= 3:
+        one prefill + one decode, plus the prefill-chunk program when
+        chunked prefill / prefix-cache resume dispatches it)."""
         return len(self.program_signatures)
 
     # -- submission --------------------------------------------------------
@@ -471,36 +641,25 @@ class GenerationEngine:
             _m_handles_failed.inc(expired, reason="deadline")
             _m_requests.inc(expired, status="failed")
         prefill_err: Optional[BaseException] = None
+        stepped: set = set()
         for idx, act in self.scheduler.admit():
-            try:
-                self._prefill_one(idx, act)
-            except Exception as e:
-                if is_oom(e) and self._note_oom():
-                    # device OOM on a prefill gets the same degrade the
-                    # decode path gets, not a terminal failure: nothing
-                    # was emitted yet, so the request requeues
-                    # recompute-style (a preempt of itself) after
-                    # compacting, and the next step retries it
-                    logger.warning(
-                        "prefill hit device OOM (%d consecutive); "
-                        "defragmenting and requeueing request %d",
-                        self._consecutive_ooms,
-                        act.req.request_id,
-                    )
-                    self.pool.defragment(
-                        [a.seq for _, a in self.scheduler.active]
-                    )
-                    self.scheduler.preempt(idx)
-                    continue
-                # fail THIS request only and keep admitting: aborting
-                # mid-loop would leave later-admitted slots with no
-                # prefill (empty ``generated``), poisoning the next
-                # decode batch
-                self.scheduler.finish(idx, error=e)
-                _m_requests.inc(status="failed")
-                _m_handles_failed.inc(reason=_fail_reason(e))
-                if prefill_err is None:
-                    prefill_err = e
+            stepped.add(idx)
+            err = self._try_prefill(idx, act, first=True)
+            if err is not None and prefill_err is None:
+                prefill_err = err
+        # slots admitted in EARLIER steps still mid-prompt (chunked
+        # prefill) advance one chunk per step, interleaved with the
+        # decode batch below — the bounded-stall property
+        for idx, act in self.scheduler.active:
+            if (
+                idx in stepped
+                or act.generated
+                or self.scheduler.slots[idx] is not act
+            ):
+                continue
+            err = self._try_prefill(idx, act, first=False)
+            if err is not None and prefill_err is None:
+                prefill_err = err
         if prefill_err is not None:
             # every surviving slot is prefilled; propagate now, before
             # decode, so synchronous drivers see the device error
@@ -512,6 +671,8 @@ class GenerationEngine:
             for idx, act in batch:
                 if self.scheduler.slots[idx] is not act:
                     continue  # preempted as a victim already
+                if not act.generated:
+                    continue  # still prefilling in chunks
                 if self.scheduler.grow(idx):
                     ready.append((idx, act))
             # growth for a later slot may have evicted an earlier one
@@ -555,13 +716,166 @@ class GenerationEngine:
             "and preempting the youngest sequence",
             self._consecutive_ooms,
         )
-        self.pool.defragment([a.seq for _, a in self.scheduler.active])
+        self._defragment_locked()
         victim = self.scheduler._youngest_active(exclude=-1)
         if victim is not None:
             self.scheduler.preempt(victim)
         return True
 
+    def _try_prefill(
+        self, idx: int, act: _Active, first: bool
+    ) -> Optional[BaseException]:
+        """One prefill advance (full prompt, or one chunk) under the
+        step's failure contract: device OOM degrades to defragment +
+        requeue-self (nothing emitted yet — recompute-style), anything
+        else fails THIS request only so later slots still step (an
+        abort mid-loop would leave them with no prefill, poisoning the
+        decode batch). Returns the non-OOM error, if any, for the caller
+        to re-raise once every slot has been serviced."""
+        try:
+            if first:
+                self._prefill_one(idx, act)
+            else:
+                self._advance_prefill(idx, act)
+            return None
+        except Exception as e:
+            if is_oom(e) and self._note_oom():
+                logger.warning(
+                    "prefill hit device OOM (%d consecutive); "
+                    "defragmenting and requeueing request %d",
+                    self._consecutive_ooms,
+                    act.req.request_id,
+                )
+                self._defragment_locked()
+                self.scheduler.preempt(idx)
+                return None
+            self.scheduler.finish(idx, error=e)
+            _m_requests.inc(status="failed")
+            _m_handles_failed.inc(reason=_fail_reason(e))
+            return e
+
+    def _defragment_locked(self) -> Dict[int, int]:
+        """Pool compaction with every live page list renumbered — the
+        sequences', the prefix cache's (cached prefixes survive), AND
+        any slot's pending copy-on-write donor page. The cow reference
+        is held as a bare index on ``_Active``, not a list the pool can
+        rewrite in place, so it is wrapped here and written back: a
+        defragment between admission and ``_apply_cow`` (an earlier
+        slot's prefill OOM) would otherwise leave a stale donor index —
+        the later clone would copy whatever page landed there (silent KV
+        corruption) and free the wrong page's reference."""
+        acts = [a for _, a in self.scheduler.active]
+        cow_lists = [[a.cow_src] for a in acts if a.cow_src is not None]
+        page_lists: List[List[int]] = list(cow_lists)
+        if self.prefix_cache is not None:
+            page_lists.extend(self.prefix_cache.entry_page_lists())
+        remap = self.pool.defragment(
+            [a.seq for a in acts], page_lists=page_lists
+        )
+        it = iter(cow_lists)
+        for a in acts:
+            if a.cow_src is not None:
+                a.cow_src = next(it)[0]
+        return remap
+
     def _prefill_one(self, idx: int, act: _Active) -> None:
+        """First prefill service for a newly admitted slot: route to the
+        one-pass program, or to the chunk program when the prompt
+        exceeds the chunk size or a prefix-cache hit starts mid-prompt."""
+        req = act.req
+        plen = len(req.prompt)
+        if self.prefix_cache is not None:
+            _m_prefix_lookups.inc()
+            if act.cached_tokens > 0:
+                _m_prefix_hits.inc()
+                _m_prefix_tokens_saved.inc(act.cached_tokens)
+        chunking = self.prefill_chunk_tokens > 0
+        if act.cached_tokens > 0 or (
+            chunking and plen > self.prefill_chunk_tokens
+        ):
+            self._apply_cow(act)
+            act.prefill_pos = act.cached_tokens
+            self._advance_prefill(idx, act)
+            return
+        self._prefill_full(idx, act)
+
+    def _apply_cow(self, act: _Active) -> None:
+        """Copy-on-write for a cached prefix that ends INSIDE a donor
+        page: clone the donor's page row into this sequence's private
+        page, then drop the temporary donor reference. Positions up to
+        ``cached_tokens`` are then valid; the chunk prefill overwrites
+        from the divergence point on. Plain device indexing, like
+        ``defragment()`` — not a step program."""
+        if act.cow_src is None:
+            return
+        src = act.cow_src
+        dst = act.seq.pages[act.cached_tokens // self.page_size]
+        pool = self.pool
+        pool.k = pool.k.at[:, dst].set(pool.k[:, src])
+        pool.v = pool.v.at[:, dst].set(pool.v[:, src])
+        act.cow_src = None
+        pool.free([src])
+
+    def _register_prefix(self, act: _Active) -> None:
+        """A finished prefill publishes its prompt's complete pages for
+        future identical prefixes to share."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(act.req.prompt, act.seq.pages)
+
+    def _advance_prefill(self, idx: int, act: _Active) -> None:
+        """Dispatch ONE prefill chunk (the third compiled program); on
+        the final chunk, sample and emit the first token and register
+        the prompt's pages in the prefix cache."""
+        req = act.req
+        plen = len(req.prompt)
+        start = act.prefill_pos
+        c = self._chunk_c
+        valid = min(c, plen - start)
+        chunk_row = np.zeros((1, c), np.int32)
+        chunk_row[0, :valid] = req.prompt[start : start + valid]
+        ptab = act.seq.table(self._max_pages)
+        args = (
+            chunk_row,
+            np.int32(start),
+            np.int32(valid),
+            np.int32(plen),
+            ptab,
+            np.float32(req.temperature),
+            np.int32(req.seed),
+            np.float32(req.top_p),
+        )
+        pool = self.pool
+        self._record_program(
+            "prefill_chunk", self._params_dev, pool.k, *args
+        )
+
+        def dispatch():
+            import jax
+
+            _chaos.site("serve.prefill_chunk")
+            return jax.block_until_ready(
+                self._prefill_chunk_jit(
+                    self._params_dev, pool.k, pool.v, *args
+                )
+            )
+
+        with _span(
+            "serve.prefill_chunk",
+            request=req.request_id,
+            start=start,
+            tokens=valid,
+        ):
+            pool.k, pool.v, tok = run_with_retries(
+                dispatch,
+                what=f"serve.prefill_chunk request {req.request_id}",
+            )
+        act.prefill_pos = start + valid
+        _m_prefill_chunks.inc()
+        if act.prefill_pos >= plen:
+            self._register_prefix(act)
+            self._emit(idx, act, int(tok))
+
+    def _prefill_full(self, idx: int, act: _Active) -> None:
         req = act.req
         plen = len(req.prompt)
         prompt_row = np.zeros((1, self.max_seq_len), np.int32)
@@ -597,6 +911,8 @@ class GenerationEngine:
             pool.k, pool.v, tok = run_with_retries(
                 dispatch, what=f"serve.prefill request {req.request_id}"
             )
+        act.prefill_pos = plen
+        self._register_prefix(act)
         self._emit(idx, act, int(tok))
 
     def _decode_batch(self, ready: List[Tuple[int, _Active]]) -> None:
@@ -660,6 +976,7 @@ class GenerationEngine:
             float(sum(s is not None for s in self.scheduler.slots))
         )
         _m_pages_in_use.set(float(self.pool.pages_in_use))
+        _m_pages_shared.set(float(self.pool.pages_shared))
 
     def run_until_idle(self) -> None:
         """Drive :meth:`step` until queue and slots are empty (the
@@ -671,11 +988,11 @@ class GenerationEngine:
         """Compact live KV pages to the lowest pool indices between steps
         (page tables are rebuilt from the sequences every step, so the
         renumbering is transparent to in-flight generation). Returns the
-        ``old -> new`` page remap. See :meth:`PagePool.defragment`."""
+        ``old -> new`` page remap (prefix-cache entries and pending
+        copy-on-write donors are renumbered too). See
+        :meth:`PagePool.defragment`."""
         with self._step_lock:
-            return self.pool.defragment(
-                [a.seq for _, a in self.scheduler.active]
-            )
+            return self._defragment_locked()
 
     # -- supervision -------------------------------------------------------
 
@@ -717,7 +1034,8 @@ class GenerationEngine:
         emitted bytes stay identical — the page pool is re-zeroed, and
         the engine is marked healthy again. The compiled step programs
         survive (every shape is unchanged), so recovery adds zero
-        recompiles: ``num_step_programs`` stays <= 2."""
+        recompiles: ``num_step_programs`` stays within its budget
+        (<= 2, or <= 3 with chunked prefill / the prefix cache)."""
         if self._stop_wedged:
             # the old stepping thread never exited; flipping healthy here
             # would accept work nothing can step (start() still refuses
@@ -734,6 +1052,11 @@ class GenerationEngine:
             for idx, _ in reversed(self.scheduler.active):
                 self.scheduler.preempt(idx)
             self.pool.reset()
+            if self.prefix_cache is not None:
+                # the cached k/v died with the device state; reset()
+                # already rebuilt the free list, so drop host entries
+                # WITHOUT releasing pages
+                self.prefix_cache.clear(free_pages=False)
             self._consecutive_ooms = 0
             self._poison = None  # a queued kill is moot on rebuilt state
             self.healthy = True
@@ -764,6 +1087,12 @@ class GenerationEngine:
             ),
             "pages_in_use": self.pool.pages_in_use,
             "pages_capacity": self.pool.num_pages,
+            "pages_shared": self.pool.pages_shared,
+            "prefix_cache": (
+                self.prefix_cache.stats()
+                if self.prefix_cache is not None
+                else None
+            ),
             "stepping_thread_alive": (
                 thread.is_alive() if thread is not None else None
             ),

@@ -19,22 +19,37 @@ PAPERS.md) decouples the two lifetimes:
   finished sequence's pages return to the pool immediately, so HBM is
   bounded by LIVE tokens, not by slots × max_len.
 
+Pages are REFCOUNTED: the page indirection means any number of page
+tables may name the same physical page, which is what shared-prefix KV
+caching rides on — :class:`PrefixCache` maps token prefixes to the
+pages that already hold their k/v, so identical system prompts /
+few-shot templates dedup to one physical copy and a new request's
+prefill skips the shared span entirely. A page returns to the free list
+only when its LAST reference drops. Shared pages are immutable by
+construction (only COMPLETE prompt pages are ever registered, and
+decode appends past them); a request diverging inside a cached page
+gets a private copy-on-write clone (the engine copies the page row,
+then overwrites from the divergence point).
+
 Exhaustion raises
 :class:`~tensorframes_tpu.utils.failures.PagePoolExhausted` — the
-scheduler's cue to preempt-and-requeue, never a crash.
+scheduler's cue to evict cache entries, then preempt-and-requeue,
+never a crash.
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
-from typing import Dict, Iterable, List, Sequence
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..utils import chaos as _chaos
 from ..utils.failures import PagePoolExhausted
 
-__all__ = ["PagePool", "SequencePages", "pages_needed"]
+__all__ = ["PagePool", "PrefixCache", "SequencePages", "pages_needed"]
 
 
 def pages_needed(tokens: int, page_size: int) -> int:
@@ -94,6 +109,10 @@ class PagePool:
         # sits on the request-finish/preempt hot path.
         self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
         self._free_set = set(self._free)
+        #: per-page reference count: 1 at alloc, +1 per ref() (a second
+        #: page table or the prefix cache naming the same page), -1 per
+        #: free(); the page returns to the free list at 0
+        self._refcount = np.zeros(self.num_pages, np.int32)
 
     # -- allocation --------------------------------------------------------
 
@@ -111,18 +130,43 @@ class PagePool:
             grant = self._free[-n:][::-1]
             del self._free[len(self._free) - n :]
             self._free_set.difference_update(grant)
+            self._refcount[grant] = 1
             return grant
 
-    def free(self, pages: Iterable[int]) -> None:
+    def ref(self, pages: Iterable[int]) -> None:
+        """Take one more reference on each LIVE page — how a second page
+        table (or the prefix cache) comes to share a physical page. The
+        sharer releases through the same :meth:`free` as an owner."""
+        with self._lock:
+            pages = [int(p) for p in pages]
+            for p in pages:
+                if not 0 <= p < self.num_pages:
+                    raise ValueError(f"page {p} is not a pool page")
+                if p in self._free_set or self._refcount[p] < 1:
+                    raise ValueError(f"cannot ref free page {p}")
+            for p in pages:
+                self._refcount[p] += 1
+
+    def free(self, pages: Iterable[int]) -> int:
+        """Drop one reference per page; pages whose LAST reference this
+        was return to the free list. Returns how many actually freed
+        (the prefix cache's eviction loop needs the distinction: evicting
+        an entry whose pages live sequences still share frees nothing
+        NOW — those pages free later, when the sequences release)."""
+        freed = 0
         with self._lock:
             for p in pages:
                 p = int(p)
                 if not 0 <= p < self.num_pages:
                     raise ValueError(f"page {p} is not a pool page")
-                if p in self._free_set:
+                if p in self._free_set or self._refcount[p] < 1:
                     raise ValueError(f"double free of page {p}")
-                self._free.append(p)
-                self._free_set.add(p)
+                self._refcount[p] -= 1
+                if self._refcount[p] == 0:
+                    self._free.append(p)
+                    self._free_set.add(p)
+                    freed += 1
+        return freed
 
     @property
     def pages_free(self) -> int:
@@ -132,6 +176,14 @@ class PagePool:
     @property
     def pages_in_use(self) -> int:
         return self.num_pages - self.pages_free
+
+    @property
+    def pages_shared(self) -> int:
+        """Pages currently named by more than one reference (sequences
+        and/or the prefix cache) — the dedup the shared-prefix cache is
+        buying, exported as the ``serve.kv_pages_shared`` gauge."""
+        with self._lock:
+            return int((self._refcount > 1).sum())
 
     def reset(self) -> None:
         """Crash recovery: discard ALL device state and bookkeeping —
@@ -155,15 +207,24 @@ class PagePool:
             self.v = jnp.zeros(shape, dtype)
             self._free = list(range(self.num_pages - 1, -1, -1))
             self._free_set = set(self._free)
+            self._refcount[:] = 0
 
     # -- defragmentation ---------------------------------------------------
 
     def defragment(
-        self, sequences: Sequence["SequencePages"]
+        self,
+        sequences: Sequence["SequencePages"],
+        page_lists: Sequence[List[int]] = (),
     ) -> Dict[int, int]:
         """Compact every live page to the lowest pool indices: one device
         gather per pool array rewrites page CONTENTS, and each sequence's
         table is renumbered in place. Returns the ``old -> new`` remap.
+
+        ``page_lists``: additional page-number lists to renumber in
+        place — the prefix cache's entries pass theirs here, so cached
+        prefixes survive compaction. A page named by several owners is
+        legitimate exactly when its refcount covers them; anything past
+        the refcount is the corruption this check existed to catch.
 
         With an indirection table any free page is as good as any other,
         so steady-state serving never needs this; it exists for pool
@@ -171,12 +232,19 @@ class PagePool:
         snapshot/restore, where a contiguous live region is the useful
         invariant."""
         with self._lock:
-            live: List[int] = []
-            for seq in sequences:
-                live.extend(seq.pages)
-            if len(set(live)) != len(live):
-                raise ValueError("a page is owned by two sequences")
-            remap = {old: new for new, old in enumerate(sorted(live))}
+            owners: Dict[int, int] = {}
+            all_lists: List[List[int]] = [seq.pages for seq in sequences]
+            all_lists.extend(page_lists)
+            for pages in all_lists:
+                for p in pages:
+                    owners[p] = owners.get(p, 0) + 1
+            for p, n in owners.items():
+                if n > int(self._refcount[p]):
+                    raise ValueError(
+                        f"page {p} named by {n} owners but refcount is "
+                        f"{int(self._refcount[p])}"
+                    )
+            remap = {old: new for new, old in enumerate(sorted(owners))}
             # perm[new] = old for live pages; free pages fill the tail in
             # index order; trash stays trash
             tail = [p for p in range(self.num_pages) if p not in remap]
@@ -187,8 +255,9 @@ class PagePool:
             perm[self.num_pages] = self.trash_page
             self.k = self.k[:, perm]
             self.v = self.v[:, perm]
-            for seq in sequences:
-                seq.pages = [remap[p] for p in seq.pages]
+            self._refcount = self._refcount[perm[: self.num_pages]]
+            for pages in all_lists:
+                pages[:] = [remap[p] for p in pages]
             self._free = list(range(self.num_pages - 1, len(remap) - 1, -1))
             self._free_set = set(self._free)
             return remap
@@ -242,3 +311,212 @@ class SequencePages:
         out = np.full(max_pages, self.pool.trash_page, np.int32)
         out[: len(self.pages)] = self.pages
         return out
+
+
+class _PrefixEntry:
+    """One cached prompt prefix: the page-aligned token span and the
+    physical pages holding its k/v (the cache holds one reference on
+    each). ``keys`` are the per-page-count digests registered in the
+    lookup index, kept so eviction can remove exactly its own keys."""
+
+    __slots__ = ("tokens", "pages", "keys", "full_key")
+
+    def __init__(self, tokens: np.ndarray, pages: List[int]):
+        self.tokens = tokens
+        self.pages = pages
+        self.keys: List[bytes] = []
+        self.full_key: bytes = b""
+
+
+class PrefixCache:
+    """Token-prefix -> physical-pages index over a :class:`PagePool` —
+    shared-prefix KV caching (vLLM's automatic prefix caching shaped for
+    the static-pool engine).
+
+    A finished prefill registers its prompt's COMPLETE pages
+    (:meth:`insert`); admission asks :meth:`acquire` for the longest
+    page-aligned cached prefix of a new prompt and gets those pages
+    refcounted into the new sequence's table, so the engine prefills
+    only the uncached suffix (chunked prefill picks up mid-prompt).
+    Shared pages are immutable: decode appends strictly past a prompt's
+    complete pages, so divergence never writes into one. A prompt that
+    diverges INSIDE a cached page gets a private copy-on-write clone:
+    :meth:`acquire` returns the donor page to copy plus how many of its
+    leading positions are reusable; the engine copies the page row and
+    overwrites from the divergence point.
+
+    Keys are sha1 digests of the token bytes per page-aligned prefix
+    length, verified against the stored tokens on hit (digest collision
+    can downgrade a hit to a miss, never corrupt). Entries are LRU:
+    bounded by ``max_entries``, and evicted on demand when the pool runs
+    dry (:meth:`evict_pages` — the scheduler tries that before
+    preempting live sequences). Thread-safety: a lock guards the maps —
+    mutation happens on the engine's stepping thread, but stats and
+    ``/healthz`` read concurrently."""
+
+    def __init__(self, pool: PagePool, max_entries: int = 256):
+        self.pool = pool
+        self.page_size = pool.page_size
+        self.max_entries = int(max_entries)
+        self._entries: "OrderedDict[bytes, _PrefixEntry]" = OrderedDict()
+        self._index: Dict[bytes, _PrefixEntry] = {}
+        self._lock = threading.Lock()
+        #: host-side stats (obs counters live in the engine): acquire
+        #: calls, acquires that returned any cached tokens, and tokens
+        #: whose prefill was skipped thanks to the cache
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_saved = 0
+
+    @staticmethod
+    def _key(tokens: np.ndarray) -> bytes:
+        return hashlib.sha1(
+            np.ascontiguousarray(tokens, np.int32).tobytes()
+        ).digest()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "lookups": self.lookups,
+                "hits": self.hits,
+                "tokens_saved": self.tokens_saved,
+            }
+
+    # -- registration ------------------------------------------------------
+
+    def insert(self, prompt: np.ndarray, pages: Sequence[int]) -> bool:
+        """Register a prefilled prompt's COMPLETE pages (``len(prompt) //
+        page_size`` of them — a partial trailing page is still mutable
+        and never shared). Takes one pool reference per page; idempotent
+        for an already-registered prompt (LRU touch only). Returns
+        whether a new entry was created."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        k_full = len(prompt) // self.page_size
+        if k_full < 1:
+            return False
+        tokens = prompt[: k_full * self.page_size].copy()
+        full_key = self._key(tokens)
+        with self._lock:
+            if full_key in self._entries:
+                self._entries.move_to_end(full_key)
+                return False
+            ent = _PrefixEntry(tokens, [int(p) for p in pages[:k_full]])
+            self.pool.ref(ent.pages)
+            ent.full_key = full_key
+            for k in range(1, k_full + 1):
+                key = self._key(tokens[: k * self.page_size])
+                # longest-prefix lookups walk k downward, so pointing a
+                # shorter shared prefix at the newest entry is safe even
+                # when it displaces an older entry's short keys
+                self._index[key] = ent
+                ent.keys.append(key)
+            self._entries[full_key] = ent
+            while len(self._entries) > self.max_entries:
+                self._drop_locked(next(iter(self._entries)))
+            return True
+
+    # -- lookup ------------------------------------------------------------
+
+    def acquire(
+        self, prompt: np.ndarray
+    ) -> Tuple[List[int], Optional[int], int]:
+        """Longest cached page-aligned prefix of ``prompt``; returns
+        ``(shared_pages, cow_src_page, cached_tokens)``.
+
+        ``shared_pages`` arrive with one NEW reference each (the caller
+        owns it; release through the usual ``free``). ``cow_src_page``,
+        when set, also carries one TEMPORARY reference: the prompt
+        diverges (or simply ends) inside the donor's next page, and its
+        first ``cached_tokens - len(shared_pages) * page_size``
+        positions are reusable once the caller clones the page — the
+        caller must ``pool.free([cow_src_page])`` after cloning (the
+        reference pins the donor contents until then).
+
+        ``cached_tokens`` is capped at ``len(prompt) - 1``: the last
+        prompt position must always be recomputed, because the first
+        sampled token needs its logits."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        ps = self.page_size
+        with self._lock:
+            self.lookups += 1
+            kcap = (len(prompt) - 1) // ps
+            for k in range(kcap, 0, -1):
+                ent = self._index.get(self._key(prompt[: k * ps]))
+                if ent is None:
+                    continue
+                if not np.array_equal(ent.tokens[: k * ps], prompt[: k * ps]):
+                    continue  # digest collision: treat as a miss
+                cached = k * ps
+                cow_src: Optional[int] = None
+                if len(ent.pages) > k:
+                    # partial-page extension: count matching tokens into
+                    # the donor's next page, capped to plen - 1
+                    upto = min(len(prompt) - 1, (k + 1) * ps) - k * ps
+                    nxt = ent.tokens[k * ps : k * ps + upto]
+                    m = int(
+                        np.argmin(
+                            np.concatenate(
+                                [
+                                    nxt == prompt[k * ps : k * ps + upto],
+                                    [False],
+                                ]
+                            )
+                        )
+                    )
+                    if m > 0:
+                        cow_src = ent.pages[k]
+                        cached += m
+                shared = list(ent.pages[:k])
+                self.pool.ref(shared)
+                if cow_src is not None:
+                    self.pool.ref([cow_src])
+                self._entries.move_to_end(ent.full_key)
+                self.hits += 1
+                self.tokens_saved += cached
+                return shared, cow_src, cached
+            return [], None, 0
+
+    # -- eviction ----------------------------------------------------------
+
+    def _drop_locked(self, full_key: bytes) -> int:
+        ent = self._entries.pop(full_key)
+        for key in ent.keys:
+            if self._index.get(key) is ent:
+                del self._index[key]
+        return self.pool.free(ent.pages)
+
+    def evict_pages(self, need: int) -> int:
+        """Drop least-recently-used entries until at least ``need`` pages
+        returned to the free list, or the cache is empty. Returns pages
+        actually freed — entries whose pages live sequences still share
+        free nothing NOW (the sequence's release frees them later), so a
+        0 return with entries remaining is possible and the caller
+        should fall through to preemption."""
+        freed = 0
+        with self._lock:
+            while freed < need and self._entries:
+                freed += self._drop_locked(next(iter(self._entries)))
+        return freed
+
+    def clear(self, free_pages: bool = True) -> None:
+        """Drop every entry. ``free_pages=False`` skips the pool
+        release — for use right AFTER :meth:`PagePool.reset`, which
+        already rebuilt the free list (freeing then would corrupt it)."""
+        with self._lock:
+            if free_pages:
+                while self._entries:
+                    self._drop_locked(next(iter(self._entries)))
+            else:
+                self._entries.clear()
+                self._index.clear()
+
+    def entry_page_lists(self) -> List[List[int]]:
+        """The live entries' page lists, for
+        :meth:`PagePool.defragment`'s in-place renumbering."""
+        with self._lock:
+            return [ent.pages for ent in self._entries.values()]

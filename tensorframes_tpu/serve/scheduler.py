@@ -9,13 +9,18 @@ testable without compiling anything. The
   host memory, the same stance the scoring server takes with its
   connection semaphore).
 - :meth:`Scheduler.admit` moves queued requests into free decode slots,
-  reserving prompt pages; the engine then prefills each admission.
+  reserving prompt pages; with a :class:`~.kv_pages.PrefixCache`
+  attached, the longest cached page-aligned prefix of the prompt is
+  refcount-shared into the new sequence first, and only the uncached
+  remainder is allocated fresh.
 - :meth:`Scheduler.grow` reserves the next decode position's page for a
-  running sequence; on :class:`PagePoolExhausted` it PREEMPTS the
-  youngest other sequence — pages freed, request requeued at the FRONT
-  of the queue with its progress folded into the prompt (recompute-style
-  preemption: the re-admitted prefill replays prompt + emitted tokens,
-  so the consumer's stream continues without replay or loss).
+  running sequence; on :class:`PagePoolExhausted` it first EVICTS
+  prefix-cache entries (cold cached prefixes go before live work), then
+  PREEMPTS the youngest other sequence — pages freed, request requeued
+  at the FRONT of the queue with its progress folded into the prompt
+  (recompute-style preemption: the re-admitted prefill replays prompt +
+  emitted tokens, so the consumer's stream continues without replay or
+  loss).
 
 Preemption rides the failure taxonomy in ``utils/failures.py``
 (:func:`record_preemption`, :class:`PagePoolExhausted`) — pool
@@ -133,7 +138,10 @@ class GenRequest:
 class _Active:
     """A slot's running sequence: request + page holdings + progress."""
 
-    __slots__ = ("req", "seq", "generated", "admit_order", "last_emit_t")
+    __slots__ = (
+        "req", "seq", "generated", "admit_order", "last_emit_t",
+        "prefill_pos", "cached_tokens", "cow_src",
+    )
 
     def __init__(self, req: GenRequest, seq: SequencePages, admit_order: int):
         self.req = req
@@ -141,6 +149,20 @@ class _Active:
         self.generated: List[int] = []
         self.admit_order = admit_order
         self.last_emit_t: Optional[float] = None
+        #: prompt positions whose k/v are already in this sequence's
+        #: pages (a prefix-cache hit starts this > 0; chunked prefill
+        #: advances it one chunk per engine step until it reaches the
+        #: prompt length). The slot joins the decode batch only once the
+        #: first token is emitted (``generated`` non-empty).
+        self.prefill_pos = 0
+        #: prompt positions covered by the prefix cache at admission
+        self.cached_tokens = 0
+        #: donor page to copy-on-write before prefilling (a cached
+        #: prefix that ends inside this page); carries one temporary
+        #: pool reference the holder must drop — the engine drops it
+        #: after cloning, finish/preempt drop it when the slot dies
+        #: first
+        self.cow_src: Optional[int] = None
 
     @property
     def length(self) -> int:
@@ -164,6 +186,7 @@ class Scheduler:
         max_slots: int,
         queue_capacity: int,
         max_seq_len: int,
+        prefix_cache=None,
     ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1; got {max_slots}")
@@ -171,6 +194,10 @@ class Scheduler:
         self.max_slots = int(max_slots)
         self.max_seq_len = int(max_seq_len)
         self.queue_capacity = int(queue_capacity)
+        #: optional :class:`~.kv_pages.PrefixCache`: admission shares
+        #: cached prompt-prefix pages into new sequences, and pool
+        #: exhaustion evicts cache entries before preempting live work
+        self.prefix_cache = prefix_cache
         self.slots: List[Optional[_Active]] = [None] * self.max_slots
         self._waiting: Deque[GenRequest] = deque()
         self._lock = threading.Condition()
@@ -264,13 +291,40 @@ class Scheduler:
                 req = self._waiting.popleft()
                 self._lock.notify_all()
             seq = SequencePages(self.pool)
+            cow_src: Optional[int] = None
+            cached = 0
+            if self.prefix_cache is not None:
+                shared, cow_src, cached = self.prefix_cache.acquire(
+                    req.prompt
+                )
+                seq.pages = shared  # refcounted by acquire; release() frees
             try:
-                seq.ensure(len(req.prompt))
+                try:
+                    seq.ensure(len(req.prompt))
+                except PagePoolExhausted:
+                    if self.prefix_cache is None:
+                        raise
+                    # cold cached prefixes go before live admissions —
+                    # but only the SHORTFALL beyond the pool's free
+                    # pages, so warm prefixes the pool could keep are
+                    # not over-evicted; the retried ensure re-raises if
+                    # eviction could not cover it
+                    missing = pages_needed(
+                        len(req.prompt), self.pool.page_size
+                    ) - len(seq.pages)
+                    shortfall = missing - self.pool.pages_free
+                    if shortfall > 0:
+                        self.prefix_cache.evict_pages(shortfall)
+                    seq.ensure(len(req.prompt))
             except PagePoolExhausted:
+                if cow_src is not None:
+                    self.pool.free([cow_src])
                 seq.release()
                 self._requeue_front(req)
                 break
             act = _Active(req, seq, self._admit_counter)
+            act.cached_tokens = cached
+            act.cow_src = cow_src
             self._admit_counter += 1
             self.slots[idx] = act
             admitted.append((idx, act))
@@ -291,6 +345,11 @@ class Scheduler:
                 act.seq.ensure(act.length)
                 return True
             except PagePoolExhausted:
+                if (
+                    self.prefix_cache is not None
+                    and self.prefix_cache.evict_pages(1) > 0
+                ):
+                    continue  # a cold cached prefix paid instead
                 victim_idx = self._youngest_active(exclude=idx)
                 if victim_idx is None:
                     # nothing left to evict but the requester itself; its
@@ -321,6 +380,7 @@ class Scheduler:
         handle keeps streaming; re-admission emits only new tokens)."""
         act = self.slots[idx]
         assert act is not None
+        self._drop_cow(act)
         act.seq.release()
         self.slots[idx] = None
         req = act.req
@@ -343,10 +403,20 @@ class Scheduler:
         self._requeue_front(new_req)
         return new_req
 
+    def _drop_cow(self, act: _Active) -> None:
+        """Release a pending copy-on-write donor reference (taken by
+        ``PrefixCache.acquire``) when the slot dies before the engine
+        cloned the page. Idempotent — the engine clears ``cow_src``
+        itself after cloning."""
+        if act.cow_src is not None:
+            self.pool.free([act.cow_src])
+            act.cow_src = None
+
     def finish(self, idx: int, error: Optional[BaseException] = None) -> None:
         """Terminal slot release: pages back to the pool, handle closed."""
         act = self.slots[idx]
         assert act is not None
+        self._drop_cow(act)
         act.seq.release()
         self.slots[idx] = None
         act.req.handle._finish(error)

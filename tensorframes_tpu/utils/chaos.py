@@ -11,8 +11,9 @@ Named **injection sites** sit on the host-side dispatch paths:
 
 - ``engine.dispatch`` — inside every batch-engine retry window
   (``map_blocks`` partitions, ``map_rows`` chunks, ``reduce_blocks``)
-- ``serve.prefill`` / ``serve.decode_step`` — the generation engine's
-  compiled-step dispatches (inside their retry windows)
+- ``serve.prefill`` / ``serve.prefill_chunk`` / ``serve.decode_step``
+  — the generation engine's compiled-step dispatches (inside their
+  retry windows)
 - ``kv_pages.alloc`` — the KV page-pool allocator
 - ``serving.conn`` — the scoring server's per-connection handler
 - ``jobs.block`` — inside a durable batch job's per-block execution
@@ -119,6 +120,7 @@ class ChaosFault(RuntimeError):
 SITES = (
     "engine.dispatch",
     "serve.prefill",
+    "serve.prefill_chunk",
     "serve.decode_step",
     "kv_pages.alloc",
     "serving.conn",

@@ -78,6 +78,29 @@ class Config:
     #: doomed stream is failed within a step, so this is a last-resort
     #: backstop, not the primary failure path (docs/serving_llm.md).
     serve_result_timeout_s: float = 300.0
+    #: decode-step paged-attention implementation for the serving engine
+    #: (``serve/engine.py``): ``"gather"`` — the reference formulation
+    #: (materialized page gather + one-shot softmax, ``ops.paged_attention``)
+    #: — or ``"fused"`` — the Pallas ragged paged-attention kernel
+    #: (``ops.ragged_paged_attention``: in-kernel page-table walk,
+    #: compute scales with live tokens). Per-engine override:
+    #: ``GenerationEngine(attention_impl=...)``. The two agree to float
+    #: tolerance; gather stays the default because it is the oracle.
+    serve_attention_impl: str = "gather"
+    #: chunked prefill: prompts longer than this many tokens prefill in
+    #: fixed chunks of this size, one chunk per engine step, interleaved
+    #: with decode steps — bounding the stall one long prompt imposes on
+    #: the whole decode batch. ``0`` (default) prefills every prompt in
+    #: one pass. Per-engine override:
+    #: ``GenerationEngine(prefill_chunk_tokens=...)``.
+    serve_prefill_chunk_tokens: int = 0
+    #: shared-prefix KV caching (``serve/kv_pages.py:PrefixCache``):
+    #: finished prefills register their prompt's complete pages, and new
+    #: requests with an identical page-aligned prefix share those pages
+    #: (refcounted, copy-on-write on in-page divergence) and skip
+    #: prefilling the shared span. Per-engine override:
+    #: ``GenerationEngine(prefix_cache=...)``.
+    serve_prefix_cache: bool = False
     #: fault-injection (chaos) schedule spec, e.g.
     #: ``"seed=7;serve.decode_step=transient:p=0.2;kv_pages.alloc=pool:every=9"``.
     #: Empty (the default) disables every injection site down to a single

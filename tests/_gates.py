@@ -1,0 +1,33 @@
+"""Version gates for known environment-dependent test failures.
+
+The parallel layer calls the TOP-LEVEL ``jax.shard_map`` API; jax
+releases before 0.5 expose only ``jax.experimental.shard_map``, so on
+those every code path that crosses a mesh (ring/ulysses attention,
+distributed engine ops, expert-parallel MoE, pipeline training) raises
+``AttributeError: module 'jax' has no attribute 'shard_map'`` before any
+real work happens. Rather than leave that as 36 red tier-1 entries on
+such environments, the affected tests carry this EXPLICIT gate: the
+failure mode is a known jax-version gap, not a regression, and the skip
+reason says exactly that. On jax >= 0.5 the gate is inert and the tests
+run.
+
+(Kept out of ``conftest.py`` so the gate is imported by exactly the
+modules that need it and greppable as one symbol.)
+"""
+
+import jax
+import pytest
+
+#: True when this jax exposes the top-level ``jax.shard_map`` the
+#: parallel layer targets
+HAS_SHARD_MAP = hasattr(jax, "shard_map")
+
+requires_shard_map = pytest.mark.skipif(
+    not HAS_SHARD_MAP,
+    reason=(
+        f"jax {jax.__version__} has no top-level jax.shard_map (added in "
+        f"jax 0.5); the parallel layer targets that API, so every "
+        f"mesh-crossing path fails with AttributeError on this version — "
+        f"known version gap, not a regression"
+    ),
+)
