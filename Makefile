@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-durability test-fleet test-multihost verify bench bench-serve bench-attn bench-jobs bench-ingest bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost verify bench bench-serve bench-attn bench-jobs bench-ingest bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -51,6 +51,12 @@ test-chaos:
 test-durability:
 	$(PY) -m pytest tests/ -q -m durability
 
+# the distributed-job suite (engine/dist_jobs.py: multi-worker block
+# leasing, heartbeats, dead-worker reclamation, write fencing) — incl.
+# the real 3-subprocess kill -9 soak; CPU-only, deterministic, tier-1
+test-distjobs:
+	$(PY) -m pytest tests/ -q -m distjobs
+
 # the serving-fleet suite (serve/fleet.py: replicated engines behind the
 # health-gated router, failover + request replay) — the fast tests are
 # tier-1; the multi-replica chaos soak is marked slow and runs here too
@@ -77,7 +83,9 @@ bench-serve:
 bench-attn:
 	$(PY) bench.py paged_attn
 
-# durable-job overhead: map_rows with the journal on vs off (one JSON line)
+# durable-job overhead: map_rows with the journal on vs off, plus the
+# K-subprocess distributed-drain workers axis (TFT_BENCH_JOB_WORKERS,
+# default 1,2,4; empty disables) — one JSON line
 bench-jobs:
 	$(PY) bench.py map_rows
 

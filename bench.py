@@ -47,7 +47,12 @@ monolithic vs chunked-overlapped h2d/d2h GB/s on the same 3.1 GB
 column, plus the cold ingest→upload→score wall clock. Also exactly one
 JSON line.
 
-``python bench.py map_rows`` benchmarks the durable batch-job layer
+``python bench.py map_rows`` (``make bench-jobs``) benchmarks the
+durable batch-job layer and its distributed drain: journal on/off
+overhead, plus a K-subprocess workers axis (``TFT_BENCH_JOB_WORKERS``,
+default ``1,2,4``) draining one manifest through ``engine/dist_jobs.py``
+block leasing — aggregate rows/s and scaling efficiency per K.
+In detail, ``bench.py map_rows`` benchmarks the durable batch-job layer
 (``tensorframes_tpu/engine/jobs.py``): the same ``map_rows`` job with
 the journal **on** vs **off** (identical block loop; the delta is the
 npz spooling + ledger appends on the background journal thread),
@@ -701,7 +706,19 @@ def main_map_rows_journal():
     whose resume units carry real work. A job with sub-millisecond
     blocks finishes in milliseconds and has no business paying for
     durability; conversely, coarser blocks mean fewer resume points —
-    the granularity knob is ``Config.max_rows_per_device_call``."""
+    the granularity knob is ``Config.max_rows_per_device_call``.
+
+    A **workers axis** (``TFT_BENCH_JOB_WORKERS``, default ``1,2,4``;
+    empty disables) then drains the same job with K real subprocess
+    workers through ``engine/dist_jobs.py`` block leasing, reporting
+    aggregate rows/s and scaling efficiency
+    (``rps_K / (K * rps_1)``). The clock starts once every worker is
+    warmed up (df built, jax imported) and stops when the journal is
+    terminal, so the numbers measure the *drain*, not process startup;
+    on one shared chip/CPU the workers contend and efficiency < 1 is
+    expected — the axis exists to measure exactly that contention (and
+    to verify on multi-chip hosts that the leasing layer itself is not
+    the bottleneck)."""
     import shutil
     import tempfile
 
@@ -751,6 +768,7 @@ def main_map_rows_journal():
         dt_on = min(dt_on, one(True, i + iters))
     blocks = one.blocks
     set_config(max_rows_per_device_call=old_chunk)
+    workers_axis = _bench_job_workers(n_rows, width, job_root)
     shutil.rmtree(job_root, ignore_errors=True)
     overhead_pct = (dt_on - dt_off) / dt_off * 100.0
 
@@ -773,10 +791,105 @@ def main_map_rows_journal():
                         "journal_off": round(dt_off, 4),
                         "journal_on": round(dt_on, 4),
                     },
+                    "workers": workers_axis,
                 },
             }
         )
     )
+
+
+_DIST_WORKER_SCRIPT = r"""
+import os, sys
+import numpy as np
+import tensorframes_tpu as tft
+from tensorframes_tpu.utils import set_config
+
+tft.enable_compilation_cache()
+path, wid, ready, go = sys.argv[1:5]
+n_rows, width = int(sys.argv[5]), int(sys.argv[6])
+set_config(max_rows_per_device_call=32768)
+rng = np.random.default_rng(0)
+x = rng.normal(size=(n_rows, width)).astype(np.float32)
+df = tft.TensorFrame.from_columns({"features": x}).analyze()
+import jax.numpy as jnp
+w1 = jnp.asarray(rng.normal(size=(width, width)).astype(np.float32))
+w2 = jnp.asarray(rng.normal(size=(width,)).astype(np.float32))
+def score(features):
+    return {"s": jnp.tanh(features @ w1) @ w2}
+# genuinely warm the compile path off the clock (an unjournaled run of
+# the same workload traces + compiles the identical chunked programs),
+# then rendezvous on the go file — otherwise the K=1 baseline would
+# absorb the one-time compile while later axis points reuse the
+# persistent cache it populated, inflating scaling efficiency
+tft.run_job("map_rows", score, df, journal=False)
+import time
+open(ready, "w").close()
+while not os.path.exists(go):
+    time.sleep(0.05)
+rep = tft.run_worker("map_rows", score, df, path=path, worker_id=wid,
+                     poll_s=0.2)
+print("WORKER_DONE", wid, rep.blocks_computed)
+"""
+
+
+def _bench_job_workers(n_rows: int, width: int, job_root: str):
+    """K-subprocess drain of one manifest (``TFT_BENCH_JOB_WORKERS``):
+    aggregate rows/s per K plus scaling efficiency vs K=1. Returns the
+    detail dict for the ``map_rows`` JSON line, or ``None`` when the
+    axis is disabled."""
+    import os
+    import subprocess
+    import sys
+
+    from tensorframes_tpu.engine.dist_jobs import journal_status
+
+    spec = os.environ.get("TFT_BENCH_JOB_WORKERS", "1,2,4").strip()
+    if not spec:
+        return None
+    ks = [int(s) for s in spec.split(",") if s.strip()]
+    out = {"counts": ks, "rows_per_sec": {}, "scaling_efficiency": {}}
+    base = None  # (k, rows/s) of the first axis point
+    for k in ks:
+        path = os.path.join(job_root, f"dist-{k}")
+        marks = os.path.join(job_root, f"marks-{k}")
+        os.makedirs(marks)
+        go = os.path.join(marks, "go")
+        procs = []
+        for i in range(k):
+            ready = os.path.join(marks, f"ready-{i}")
+            procs.append(
+                (
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-c", _DIST_WORKER_SCRIPT,
+                            path, f"bench-w{i}", ready, go,
+                            str(n_rows), str(width),
+                        ],
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL,
+                    ),
+                    ready,
+                )
+            )
+        for _, ready in procs:
+            while not os.path.exists(ready):
+                time.sleep(0.05)
+        t0 = time.perf_counter()
+        open(go, "w").close()
+        for p, _ in procs:
+            rc = p.wait(timeout=1800)
+            assert rc == 0, f"bench worker exited {rc}"
+        dt = time.perf_counter() - t0
+        status = journal_status(path)
+        assert status["terminal"], status
+        rps = n_rows / dt
+        out["rows_per_sec"][str(k)] = round(rps, 1)
+        base = base if base is not None else (k, rps)
+        # per-worker throughput relative to the first axis point's
+        out["scaling_efficiency"][str(k)] = round(
+            (rps / k) / (base[1] / base[0]), 3
+        )
+    return out
 
 
 def main_ingest():

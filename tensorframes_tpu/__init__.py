@@ -49,8 +49,12 @@ from .engine import (
     row,
     run_job,
     resume_job,
+    run_worker,
+    wait_job,
+    journal_status,
     JobResult,
     QuarantinedBlock,
+    WorkerReport,
     load_quarantine,
     InputNotFoundError,
     InvalidTypeError,
@@ -88,11 +92,16 @@ __all__ = [
     "print_schema",
     "block",
     "row",
-    # durable batch jobs (engine/jobs.py)
+    # durable batch jobs (engine/jobs.py) + distributed drain
+    # (engine/dist_jobs.py)
     "run_job",
     "resume_job",
+    "run_worker",
+    "wait_job",
+    "journal_status",
     "JobResult",
     "QuarantinedBlock",
+    "WorkerReport",
     "load_quarantine",
     # frames & schema
     "Shape",

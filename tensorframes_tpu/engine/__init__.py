@@ -27,14 +27,24 @@ from .jobs import (
     resume_job,
     run_job,
 )
+from .dist_jobs import (
+    WorkerReport,
+    journal_status,
+    run_worker,
+    wait_job,
+)
 
 __all__ = [
     "BlockLedger",
     "JobResult",
     "QuarantinedBlock",
+    "WorkerReport",
+    "journal_status",
     "load_quarantine",
     "resume_job",
     "run_job",
+    "run_worker",
+    "wait_job",
     "map_blocks",
     "precompile",
     "map_rows",

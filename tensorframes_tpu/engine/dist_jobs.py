@@ -1,0 +1,1118 @@
+"""Distributed journaled jobs: K crash-prone workers drain one manifest.
+
+``engine/jobs.py`` made a batch job durable against the death of *its
+one process*; this module makes the job survive — and scale across —
+**many** processes. The ``BlockLedger``'s deterministic block plan is
+already a durable work queue (every block is an independent, pure,
+byte-reproducible unit — the RDD/Spark property the reference leaned
+on); what was missing is the coordination letting independent workers
+drain it safely with **no coordinator**: the journal directory itself
+is the source of truth, exactly as it already is for crash-resume.
+
+Mechanics (all under ``<job>/leases/``):
+
+- **atomic block leasing** — a worker claims block ``i`` by atomically
+  creating ``block-{i:05d}.e{epoch:06d}.lease`` (hard-link of a fully
+  written temp file — create-if-absent AND complete content in one
+  atomic step) carrying ``{worker_id, epoch, deadline_unix}``. The
+  *epoch is part of the filename*, so claiming a given (block, epoch)
+  has exactly one winner with no lock server.
+- **heartbeats + expiry** — a background thread renews every owned
+  lease (atomic rewrite of the epoch file with a fresh deadline) every
+  ``heartbeat_s``; a lease whose deadline passed is presumed dead and
+  any worker may **reclaim** the block by creating the ``epoch + 1``
+  file — again exactly one winner — and recomputing it (byte-identical:
+  it is literally the resume path).
+- **write fencing** — every spool write and ledger append carries the
+  writer's ``(worker_id, epoch)`` and re-validates the lease *inside*
+  the journal writer immediately before mutating: a zombie worker that
+  wakes after its lease was stolen holds a stale epoch, its late write
+  raises :class:`~tensorframes_tpu.utils.failures.StaleLeaseError`
+  (``jobs.fence_rejects_total``), and — belt and braces — replay
+  ignores any done-record superseded by a higher epoch. No torn or
+  duplicate block record ever lands. (The residual check-then-rename
+  window is harmless by construction: blocks are deterministic, so even
+  a write that slipped the fence carries byte-identical content and
+  loses the replay arbitration.)
+- **terminal markers** — a recorded block's lease file is rewritten to
+  ``state="done"`` instead of unlinked, so a worker whose in-memory
+  journal snapshot predates the record skips the block at claim time
+  instead of wastefully (and duplicate-recordingly) recomputing it.
+  Quarantine releases the lease instead (a later
+  ``retry_quarantined`` drain must be able to re-claim the block).
+
+A worker is one call — ``run_worker(op, fetches, data, path=...)`` —
+and drains in **passes**: each pass re-reads the journal, claims every
+block still unclaimed (or reclaims expired ones) as the engine's block
+loop reaches it, computes and records them, and skips everything owned
+elsewhere; between fruitless passes it polls. Workers need no network,
+no ranks, no membership — start K of them whenever, kill any of them
+wherever, add more mid-job. Any process (a worker or none of them)
+assembles the final :class:`~tensorframes_tpu.engine.jobs.JobResult`
+with :func:`wait_job`, which waits for every block to reach a terminal
+state and then runs the ordinary resume path (all blocks restore from
+their spools; quarantine/strict/torn-tail semantics are therefore
+*identical* to the single-worker journal).
+
+Liveness vs safety knobs: ``lease_ttl_s`` (how long a dead worker's
+block stays stuck before reclamation — and how long a *live* worker's
+heartbeats may stall before it is presumed dead and fenced) and
+``heartbeat_s`` (renewal cadence, default ``ttl / 3``). Leases compare
+``deadline_unix`` against the local clock, so the TTL must comfortably
+exceed heartbeat jitter + filesystem latency + inter-worker clock
+skew. The per-block retry window is clipped below the TTL
+(:class:`~tensorframes_tpu.utils.failures.retry_deadline`) so a
+retrying-but-alive worker gives up before it is presumed dead.
+
+Chaos sites: ``jobs.lease`` (claim/reclaim path) and
+``jobs.heartbeat`` (renewal — ``latency`` past the TTL is the
+presumed-dead drill). See docs/fault_tolerance.md for the cookbook and
+the multi-process kill soak in ``tests/test_dist_jobs.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..obs import span as _span
+from ..obs.metrics import counter as _counter, gauge as _gauge
+from ..utils import get_logger
+from ..utils.failures import (
+    StaleLeaseError,
+    retry_deadline,
+    run_with_retries,
+)
+from .jobs import (
+    _BLOCK_DIR,
+    _OPS,
+    BlockLedger,
+    JobResult,
+    _execute,
+    _m_fence_rejects,
+    _register_end,
+    _register_start,
+)
+
+__all__ = [
+    "LeaseManager",
+    "WorkerReport",
+    "journal_guard",
+    "journal_status",
+    "run_worker",
+    "wait_job",
+]
+
+logger = get_logger("dist_jobs")
+
+_LEASE_DIR = "leases"
+_JOURNAL_KEY = "journal"
+
+_m_claims = _counter(
+    "jobs.leases_claimed_total",
+    "Distributed-job block leases claimed fresh (epoch 0 or re-claim "
+    "of a released block)",
+)
+_m_reclaims = _counter(
+    "jobs.leases_reclaimed_total",
+    "Distributed-job block leases reclaimed from a presumed-dead "
+    "worker (expired deadline; epoch bumped, block recomputed)",
+)
+_m_heartbeats = _counter(
+    "jobs.lease_heartbeats_total",
+    "Lease heartbeat renewals across all distributed-job workers",
+)
+_g_leases_held = _gauge(
+    "jobs.leases_held",
+    "Block leases currently held, per distributed-job worker",
+    labels=("worker",),
+)
+_g_worker_blocks = _gauge(
+    "jobs.worker_blocks_recorded",
+    "Blocks durably recorded by this process, per distributed-job "
+    "worker identity",
+    labels=("worker",),
+)
+
+
+@dataclasses.dataclass
+class LeaseView:
+    """Parsed view of one lease key's CURRENT (highest-epoch) file."""
+
+    key: str
+    epoch: int
+    worker: str
+    deadline_unix: float
+    state: str  # "live" (held or expired — check the deadline) | "done"
+    fname: str
+
+    @property
+    def expired(self) -> bool:
+        return self.state != "done" and self.deadline_unix <= time.time()
+
+
+def _block_key(block: Optional[int]) -> str:
+    return _JOURNAL_KEY if block is None else f"block-{block:05d}"
+
+
+class LeaseManager:
+    """Filesystem lease table for one journal directory.
+
+    Epoch-in-the-filename is the whole trick: creating
+    ``<key>.e{epoch:06d}.lease`` is atomic create-if-absent (hard link
+    of a fully written temp file), so claiming any (key, epoch) pair
+    has exactly one winner, reclamation is an exclusive race for
+    ``epoch + 1``, and the epoch doubles as the monotonic **fencing
+    token** stamped into every journal record. The current lease for a
+    key is simply its highest-epoch file."""
+
+    def __init__(
+        self,
+        path: str,
+        worker_id: str,
+        ttl_s: float,
+        heartbeat_s: float = 0.0,
+        create: bool = True,
+    ):
+        if ttl_s <= 0:
+            raise ValueError(f"lease ttl must be > 0; got {ttl_s}")
+        self.root = path
+        self.dir = os.path.join(path, _LEASE_DIR)
+        if create:
+            os.makedirs(self.dir, exist_ok=True)
+        self.worker_id = worker_id
+        self.ttl_s = float(ttl_s)
+        self.heartbeat_s = float(heartbeat_s) or self.ttl_s / 3.0
+        self._lock = threading.Lock()
+        #: key -> (epoch, fname) for leases this manager holds live
+        self._held: Dict[str, Tuple[int, str]] = {}
+        self._stop = threading.Event()
+        self._hb: Optional[threading.Thread] = None
+        self.claimed_total = 0
+        self.reclaimed_total = 0
+
+    # -- scanning ----------------------------------------------------------
+
+    def _scan(self, key: str) -> Optional[LeaseView]:
+        """The key's current lease: its highest-epoch file, parsed. An
+        unreadable file (a crash artifact — every write here is a
+        link/rename of complete content, so this should not happen)
+        reads as an expired live lease, i.e. reclaimable."""
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return None
+        prefix = key + ".e"
+        best: Optional[Tuple[int, str]] = None
+        for n in names:
+            if not (n.startswith(prefix) and n.endswith(".lease")):
+                continue
+            try:
+                epoch = int(n[len(prefix):-len(".lease")])
+            except ValueError:
+                continue
+            if best is None or epoch > best[0]:
+                best = (epoch, n)
+        if best is None:
+            return None
+        return self._read_view(key, best[0], best[1])
+
+    def _read_view(self, key: str, epoch: int, fname: str) -> LeaseView:
+        try:
+            with open(os.path.join(self.dir, fname), "r") as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            d = {}
+        return LeaseView(
+            key=key,
+            epoch=epoch,
+            worker=str(d.get("worker", "")),
+            deadline_unix=float(d.get("deadline_unix", 0.0)),
+            state=str(d.get("state", "live")),
+            fname=fname,
+        )
+
+    def scan_all(self) -> List[LeaseView]:
+        """Current lease view of every key: ONE directory listing,
+        grouped by key with the max epoch kept, then one file read per
+        key — not a per-key re-listing (O(keys²) on big journals)."""
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return []
+        best: Dict[str, Tuple[int, str]] = {}
+        for n in names:
+            if not n.endswith(".lease"):
+                continue
+            key, sep, rest = n[: -len(".lease")].rpartition(".e")
+            if not sep:
+                continue
+            try:
+                epoch = int(rest)
+            except ValueError:
+                continue
+            cur = best.get(key)
+            if cur is None or epoch > cur[0]:
+                best[key] = (epoch, n)
+        return [
+            self._read_view(key, epoch, fname)
+            for key, (epoch, fname) in sorted(best.items())
+        ]
+
+    def live_block_leases(self) -> List[LeaseView]:
+        """Live (unexpired, not done, not ours) block leases — the
+        "someone is actively draining this journal" signal the resume
+        guard refuses on."""
+        return [
+            v
+            for v in self.scan_all()
+            if v.key != _JOURNAL_KEY
+            and v.state != "done"
+            and not v.expired
+            and v.worker != self.worker_id
+        ]
+
+    def journal_locked(self) -> bool:
+        """A live journal-level lease held by someone else — a resume or
+        assembly owns the journal; block claims must stand down."""
+        cur = self._scan(_JOURNAL_KEY)
+        return (
+            cur is not None
+            and cur.state != "done"
+            and not cur.expired
+            and cur.worker != self.worker_id
+        )
+
+    # -- claiming ----------------------------------------------------------
+
+    def _payload(self, epoch: int, state: str = "live") -> bytes:
+        return json.dumps(
+            {
+                "worker": self.worker_id,
+                "epoch": epoch,
+                "state": state,
+                "deadline_unix": time.time() + self.ttl_s,
+                "written_unix": time.time(),
+            }
+        ).encode("utf-8")
+
+    def _create_excl(self, fname: str, payload: bytes) -> bool:
+        """Atomically create ``fname`` with ``payload`` iff absent:
+        write a private temp file completely, then hard-link it to the
+        target — EEXIST means another worker won the epoch."""
+        target = os.path.join(self.dir, fname)
+        tmp = os.path.join(
+            self.dir, f".tmp-{self.worker_id}-{uuid.uuid4().hex[:8]}"
+        )
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+        try:
+            os.link(tmp, target)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def try_acquire(self, block: Optional[int]) -> Optional[int]:
+        """Claim (or reclaim) one block's lease; ``None`` is the
+        journal-level lease. Returns the held epoch, or ``None`` when
+        the block is terminal, live-leased elsewhere, or the claim race
+        was lost. Transient filesystem failures retry
+        (``run_with_retries``); the chaos site ``jobs.lease`` sits
+        inside the window."""
+        from ..utils import chaos as _chaos
+
+        key = _block_key(block)
+
+        def attempt() -> Optional[int]:
+            _chaos.site("jobs.lease")
+            now = time.time()
+            with self._lock:
+                held = self._held.get(key)
+            cur = self._scan(key)
+            if held is not None:
+                if cur is not None and cur.epoch == held[0]:
+                    return held[0]  # still ours (epoch files are exclusive)
+                # superseded or deleted underneath us: we lost it (and
+                # our old epoch file, if a heartbeat resurrected it, is
+                # dead weight — drop it so it cannot linger as a
+                # phantom stale lease)
+                self._drop_held(key, held[0], held[1])
+            if block is not None and self.journal_locked():
+                return None  # a resume/assembly owns the journal
+            if cur is None:
+                epoch, reclaim = 0, False
+            elif cur.state == "done":
+                return None  # terminal: recorded by someone, never re-run
+            elif cur.deadline_unix > now:
+                return None  # live, someone else's
+            else:
+                epoch, reclaim = cur.epoch + 1, True
+            fname = f"{key}.e{epoch:06d}.lease"
+            if not self._create_excl(fname, self._payload(epoch)):
+                return None  # lost the exclusive race for this epoch
+            if block is not None and self.journal_locked():
+                # the guard/worker handshake: the resume guard acquires
+                # the journal lease FIRST and scans block leases second;
+                # a claim re-checks the journal lease AFTER winning. So
+                # either our block lease existed when the guard scanned
+                # (it refuses), or we see its journal lease here (we
+                # retreat) — no interleaving lets both proceed.
+                try:
+                    os.unlink(os.path.join(self.dir, fname))
+                except OSError:
+                    pass
+                return None
+            with self._lock:
+                self._held[key] = (epoch, fname)
+            self._ensure_heartbeat()
+            if reclaim and key != _JOURNAL_KEY:
+                _m_reclaims.inc()
+                self.reclaimed_total += 1
+                logger.warning(
+                    "worker %s reclaimed %s at epoch %d from presumed-dead "
+                    "worker %s (lease expired %.1fs ago); recomputing",
+                    self.worker_id, key, epoch, cur.worker,
+                    now - cur.deadline_unix,
+                )
+                # housekeeping: the superseded epoch files are dead weight
+                for old in range(cur.epoch + 1):
+                    try:
+                        os.unlink(
+                            os.path.join(
+                                self.dir, f"{key}.e{old:06d}.lease"
+                            )
+                        )
+                    except OSError:
+                        pass
+            elif key != _JOURNAL_KEY:
+                _m_claims.inc()
+                self.claimed_total += 1
+            _g_leases_held.set(len(self._held), worker=self.worker_id)
+            return epoch
+
+        return run_with_retries(attempt, what="jobs.lease claim")
+
+    # -- renewal / release -------------------------------------------------
+
+    def _rewrite(self, fname: str, payload: bytes) -> None:
+        target = os.path.join(self.dir, fname)
+        tmp = target + f".w-{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+        os.replace(tmp, target)
+
+    def renew_all(self) -> None:
+        """One heartbeat sweep: rewrite every held lease with a fresh
+        deadline. The chaos site ``jobs.heartbeat`` sits inside — a
+        ``latency`` injection longer than the TTL is the presumed-dead
+        drill (the sweep stalls, the lease expires, the block is
+        reclaimed, and this worker's late write is fence-rejected)."""
+        from ..utils import chaos as _chaos
+
+        _chaos.site("jobs.heartbeat")
+        for key, (epoch, fname) in list(self._held.items()):
+            # re-validate ownership BEFORE rewriting: _rewrite is an
+            # os.replace, which would re-CREATE a superseded file the
+            # reclaimer's housekeeping already unlinked — a phantom
+            # stale lease this worker would then renew forever
+            cur = self._scan(key)
+            if (
+                cur is None
+                or cur.epoch != epoch
+                or cur.worker != self.worker_id
+            ):
+                self._drop_held(key, epoch, fname)
+                continue
+            with self._lock:
+                if self._held.get(key) != (epoch, fname):
+                    continue  # recorded/released between snapshot and now
+                self._rewrite(fname, self._payload(epoch))
+            _m_heartbeats.inc()
+
+    def _drop_held(self, key: str, epoch: int, fname: str) -> None:
+        """Forget a lease we no longer own and unlink our (now
+        superseded) epoch file if it still exists — never the current
+        one, which has a different epoch in its name."""
+        with self._lock:
+            if self._held.get(key) == (epoch, fname):
+                self._held.pop(key, None)
+        try:
+            os.unlink(os.path.join(self.dir, fname))
+        except OSError:
+            pass
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self.renew_all()
+            except Exception:
+                # a failed sweep is survivable until the TTL runs out;
+                # the next tick retries. Never kill the thread.
+                logger.warning(
+                    "worker %s: lease heartbeat sweep failed",
+                    self.worker_id, exc_info=True,
+                )
+
+    def _ensure_heartbeat(self) -> None:
+        if self._hb is None or not self._hb.is_alive():
+            self._hb = threading.Thread(
+                target=self._hb_loop,
+                name=f"tft-lease-hb-{self.worker_id}",
+                daemon=True,
+            )
+            self._hb.start()
+
+    def mark_done(self, block: int, epoch: int) -> None:
+        """Terminal marker: the block's record landed; rewrite the lease
+        as ``state="done"`` so no stale-snapshot worker ever re-claims
+        (and wastefully re-records) it."""
+        key = _block_key(block)
+        with self._lock:
+            held = self._held.pop(key, None)
+            if held is not None:
+                self._rewrite(held[1], self._payload(epoch, state="done"))
+        _g_leases_held.set(len(self._held), worker=self.worker_id)
+
+    def release(self, block: Optional[int]) -> None:
+        """Drop a lease and unlink its file (quarantine records and the
+        journal-level lease: the key must become claimable again)."""
+        key = _block_key(block)
+        with self._lock:
+            held = self._held.pop(key, None)
+            if held is not None:
+                try:
+                    os.unlink(os.path.join(self.dir, held[1]))
+                except OSError:
+                    pass
+        _g_leases_held.set(len(self._held), worker=self.worker_id)
+
+    def fence_check(self, block: int, epoch: int) -> None:
+        """The write fence: raise unless this worker still owns block
+        ``block`` at exactly ``epoch`` — called inside the journal
+        writer immediately before the spool rename + ledger append."""
+        cur = self._scan(_block_key(block))
+        if cur is None or cur.epoch != epoch or cur.worker != self.worker_id:
+            _m_fence_rejects.inc()
+            if cur is None:
+                detail = "the lease file is gone"
+            else:
+                detail = (
+                    f"superseded by epoch {cur.epoch} "
+                    f"(worker {cur.worker!r}, state {cur.state})"
+                )
+            raise StaleLeaseError(
+                f"worker {self.worker_id}: block {block} lease at epoch "
+                f"{epoch} is stale — {detail}; dropping the late write "
+                f"(the owner's recompute is byte-identical)"
+            )
+
+    def stop(self, unlink_held: bool = True) -> None:
+        """Stop heartbeats and (by default) release everything held so
+        other workers need not wait out the TTL."""
+        self._stop.set()
+        if self._hb is not None:
+            self._hb.join(timeout=self.heartbeat_s + 5.0)
+        if unlink_held:
+            for key in list(self._held):
+                with self._lock:
+                    held = self._held.pop(key, None)
+                if held is not None:
+                    try:
+                        os.unlink(os.path.join(self.dir, held[1]))
+                    except OSError:
+                        pass
+        _g_leases_held.set(0, worker=self.worker_id)
+
+
+# ---------------------------------------------------------------------------
+# the distributed ledger (one drain pass's view)
+# ---------------------------------------------------------------------------
+
+
+class _DistLedger(BlockLedger):
+    """One worker's view of the shared journal for ONE drain pass.
+
+    The engine's block loops drive it exactly like the single-process
+    ledger; the difference is what ``lookup`` means: a block journaled
+    or owned elsewhere is *skipped* (reported like a quarantined block
+    so the pass's partial output assembles mechanically — drain-pass
+    outputs are discarded; only :func:`wait_job`'s final resume pass
+    assembles for real), and a todo block is computed only after its
+    lease is won. Records are stamped and fenced with this worker's
+    ``(worker_id, epoch)``."""
+
+    def __init__(self, path: str, job_id: str, op: str):
+        super().__init__(path, job_id, op)
+        self._lm: Optional[LeaseManager] = None
+        self._retry_deadline_s: Optional[float] = None
+        self._skipped: set = set()
+        self._owned: Dict[int, int] = {}
+        self._progressed = False
+        self._quar_at_open = 0
+
+    def _bind(
+        self,
+        lm: LeaseManager,
+        retry_deadline_s: Optional[float],
+    ) -> None:
+        self._lm = lm
+        self._retry_deadline_s = retry_deadline_s
+        self._quar_at_open = len(self._quar)
+
+    # -- engine-facing -----------------------------------------------------
+
+    # NOTE: ``peek`` deliberately inherits the base class's in-memory
+    # form — it sits in the upload prefetchers' per-block hot loop, and
+    # a lease-directory listing per peek would be O(blocks²) across a
+    # pass. The cost is one speculative window-deep upload for a block
+    # another worker claimed since our snapshot; the lookup that
+    # follows still skips it.
+
+    def lookup(self, i: int):
+        if i in self._quar:
+            return "quarantined", None
+        if i in self._done or self.try_claim(i) is None:
+            # journaled already, terminal elsewhere, or live-leased by
+            # another worker: skip — report as quarantined so the
+            # discarded pass output assembles without this block
+            self._skipped.add(i)
+            return "quarantined", None
+        return "todo", None
+
+    def try_claim(self, i: int) -> Optional[int]:
+        if i in self._owned:
+            return self._owned[i]
+        epoch = self._lm.try_acquire(i)
+        if epoch is not None:
+            self._owned[i] = epoch
+            self._progressed = True
+        return epoch
+
+    def run_block(self, i, compute, rows=None):
+        def bounded():
+            # clip the block's transient-retry budget below the lease
+            # TTL: a worker mid-retry must give up (and let the pass
+            # fail resumable) before it is presumed dead and fenced
+            with retry_deadline(self._retry_deadline_s):
+                return compute()
+
+        return super().run_block(i, bounded, rows)
+
+    # -- fencing hooks -----------------------------------------------------
+
+    def _writer_tag(self, i: int) -> Dict[str, Any]:
+        return {
+            "worker": self._lm.worker_id,
+            "epoch": self._owned.get(i, 0),
+        }
+
+    def _fence_check(self, i: int) -> None:
+        epoch = self._owned.get(i)
+        if epoch is None:
+            _m_fence_rejects.inc()
+            raise StaleLeaseError(
+                f"worker {self._lm.worker_id}: no lease held for block "
+                f"{i}; refusing the unfenced journal write"
+            )
+        self._lm.fence_check(i, epoch)
+
+    def _on_recorded(self, i: int, done: bool = True) -> None:
+        epoch = self._owned.pop(i, None)
+        if done and epoch is not None:
+            self._lm.mark_done(i, epoch)
+        else:
+            self._lm.release(i)
+        _g_worker_blocks.inc(worker=self._lm.worker_id)
+
+    def _spool_tmp_suffix(self) -> str:
+        # concurrent workers share blocks/; tmp names must not collide
+        return "." + "".join(
+            c if c.isalnum() or c in "-_" else "-"
+            for c in self._lm.worker_id
+        )
+
+    @property
+    def quarantined_indices(self) -> List[int]:
+        # the engine drops both truly-quarantined and skipped blocks'
+        # rows from this pass's (discarded) output
+        return sorted(set(self._quar) | self._skipped)
+
+    @property
+    def newly_quarantined(self) -> int:
+        return max(0, len(self._quar) - self._quar_at_open)
+
+    def finalize(self) -> None:
+        # drain the writer, but write the completion marker only when
+        # every plan block is actually terminal in THIS view — a drain
+        # pass that skipped live-leased blocks must not declare the job
+        # complete
+        self._drain_writer()
+        if self.path is not None and not self._complete and _terminal(self):
+            super().finalize()
+        elif self._ledger_file is not None and not self._ledger_file.closed:
+            self._ledger_file.close()
+
+
+# ---------------------------------------------------------------------------
+# the worker
+# ---------------------------------------------------------------------------
+
+
+def _default_worker_id() -> str:
+    return (
+        f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:4]}"
+    )
+
+
+def _terminal(led: BlockLedger) -> bool:
+    """Every plan block with rows has reached a terminal state (done or
+    quarantined). Empty blocks (0-row partitions) are never visited by
+    the engine's block loops and count as terminal."""
+    plan = led.stored_plan
+    if plan is None:
+        return False
+    for i, entry in enumerate(plan):
+        if int(entry.get("rows", 0) or 0) == 0:
+            continue
+        if i not in led._done and i not in led._quar:
+            return False
+    return True
+
+
+def _attach(path: str, op: str) -> _DistLedger:
+    """One pass's journal snapshot: open the manifest if it exists, a
+    fresh ledger otherwise. The manifest-creation race between
+    first-attaching workers is benign by construction — every worker
+    derives the identical deterministic plan and fingerprint from the
+    same inputs, `ensure_plan` validates both on the open_ path, and
+    the write itself is an atomic rename."""
+    try:
+        led = _DistLedger.open_(path)
+    except FileNotFoundError:
+        os.makedirs(os.path.join(path, _BLOCK_DIR), exist_ok=True)
+        led = _DistLedger(
+            path, os.path.basename(os.path.normpath(path)), op
+        )
+    if led.op != op:
+        raise ValueError(
+            f"journal at {path!r} was written for op {led.op!r}; "
+            f"this worker was started for {op!r}"
+        )
+    return led
+
+
+@dataclasses.dataclass
+class WorkerReport:
+    """What one ``run_worker`` call did — serializable (``as_dict``) so
+    multi-process harnesses can collect per-worker tallies."""
+
+    worker_id: str
+    path: str
+    passes: int = 0
+    blocks_computed: int = 0
+    blocks_quarantined: int = 0
+    leases_claimed: int = 0
+    leases_reclaimed: int = 0
+    fence_rejects: int = 0
+    complete: bool = False
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def run_worker(
+    op: str,
+    fetches,
+    data,
+    *,
+    path: str,
+    worker_id: Optional[str] = None,
+    lease_ttl_s: Optional[float] = None,
+    heartbeat_s: Optional[float] = None,
+    poll_s: float = 0.5,
+    retry_deadline_frac: float = 0.8,
+    transient_pass_retries: int = 3,
+    max_idle_s: Optional[float] = None,
+    trim: bool = False,
+    feed_dict: Optional[Dict[str, str]] = None,
+    constants: Optional[Dict[str, Any]] = None,
+) -> WorkerReport:
+    """Drain one journaled job as one of K independent workers.
+
+    Every worker is started with the same ``op`` / ``fetches`` /
+    ``data`` the job was defined with (inputs are the caller's durable
+    artifact, exactly as for ``resume_job``) and the same journal
+    ``path``; the first to attach writes the manifest, and from then on
+    the filesystem coordinates everything — block leases, heartbeats,
+    reclamation of presumed-dead workers' blocks, and write fencing of
+    zombies. Workers may be started and killed at any time; adding one
+    mid-job just drains the remaining blocks faster.
+
+    Returns this worker's :class:`WorkerReport`; ``report.complete`` is
+    True when the whole job (not just this worker's share) reached a
+    terminal state. Assemble the job's :class:`JobResult` with
+    :func:`wait_job` from any process.
+
+    ``lease_ttl_s`` / ``heartbeat_s`` default to
+    ``Config.job_lease_ttl_s`` / ``Config.job_heartbeat_s`` (0 meaning
+    ``ttl / 3``). ``retry_deadline_frac`` clips each block's
+    transient-retry budget to that fraction of the TTL
+    (:class:`~tensorframes_tpu.utils.failures.retry_deadline`) so a
+    retrying-but-alive worker is never presumed dead mid-retry.
+    ``max_idle_s`` bounds how long the worker waits with nothing
+    claimable before raising ``TimeoutError`` (default: wait forever —
+    safety over liveness when another worker holds a block and is
+    merely slow).
+
+    A **transient** failure that escapes a pass (a ``jobs.block``-level
+    fault, or a retry window that ran out) does not kill the worker
+    outright: it re-scans and retries up to ``transient_pass_retries``
+    consecutive fruitless times — a long-lived lease holder dying over
+    one flaky dispatch would force pointless reclamation — and only
+    then fails (resumable, like the single-process job). Fatal errors
+    propagate immediately; blocks this worker had already recorded stay
+    recorded either way."""
+    from ..utils import get_config
+
+    if op not in _OPS:
+        raise ValueError(f"unknown job op {op!r}; expected one of {_OPS}")
+    cfg = get_config()
+    ttl = float(lease_ttl_s if lease_ttl_s is not None
+                else cfg.job_lease_ttl_s)
+    hb = float(heartbeat_s if heartbeat_s is not None
+               else cfg.job_heartbeat_s)
+    worker_id = worker_id or _default_worker_id()
+    lm = LeaseManager(path, worker_id, ttl, hb)
+    jl = lm._scan(_JOURNAL_KEY)
+    if jl is not None and not jl.expired and jl.worker != worker_id:
+        raise StaleLeaseError(
+            f"journal at {path!r} is held by {jl.worker!r} (a resume or "
+            f"assembly is in progress); start workers after it releases "
+            f"the journal lease"
+        )
+    report = WorkerReport(worker_id=worker_id, path=path)
+    registered: Optional[BlockLedger] = None
+    led: Optional[_DistLedger] = None
+    idle_since: Optional[float] = None
+    transient_budget = transient_pass_retries
+    ok = False
+    try:
+        while True:
+            led = _attach(path, op)
+            if registered is None:
+                _register_start(led, resumed=led.stored_plan is not None)
+                registered = led
+            if _terminal(led):
+                report.complete = True
+                ok = True
+                break
+            led._bind(lm, retry_deadline_s=ttl * retry_deadline_frac)
+            try:
+                with _span(
+                    "jobs.worker_pass", job=led.job_id, worker=worker_id
+                ):
+                    _execute(
+                        op, fetches, data, led, trim, feed_dict, constants
+                    )
+                led.finalize()
+            except StaleLeaseError as e:
+                # our lease on some block was stolen mid-pass (we were
+                # presumed dead); the write was fenced — drop the pass
+                # and re-scan: the reclaimer's recompute is identical
+                report.fence_rejects += 1
+                logger.warning("worker %s: pass fenced: %s", worker_id, e)
+                led.abort()
+                # leases for blocks we still hold stay valid; the next
+                # pass re-claims them from _held via try_acquire
+                continue
+            except Exception as e:
+                led.abort()
+                from ..utils.failures import is_transient
+
+                if is_transient(e) and (
+                    led.computed or transient_budget > 0
+                ):
+                    if not led.computed:
+                        transient_budget -= 1
+                    logger.warning(
+                        "worker %s: pass failed transiently (%s); "
+                        "re-scanning (%d fruitless retries left)",
+                        worker_id, str(e).split("\n", 1)[0][:200],
+                        transient_budget,
+                    )
+                    time.sleep(poll_s)
+                    continue
+                raise
+            finally:
+                report.passes += 1
+                report.blocks_computed += led.computed
+                report.blocks_quarantined += led.newly_quarantined
+            if led._progressed or led.computed:
+                idle_since = None
+                transient_budget = transient_pass_retries
+                continue  # we did work; immediately look for more
+            now = time.monotonic()
+            idle_since = idle_since if idle_since is not None else now
+            if max_idle_s is not None and now - idle_since > max_idle_s:
+                raise TimeoutError(
+                    f"worker {worker_id}: nothing claimable for "
+                    f"{max_idle_s:.1f}s and the job is not terminal "
+                    f"(blocks held live by other workers)"
+                )
+            time.sleep(poll_s)
+    finally:
+        report.leases_claimed = lm.claimed_total
+        report.leases_reclaimed = lm.reclaimed_total
+        lm.stop()
+        if registered is not None:
+            _register_end(led if led is not None else registered, ok)
+    logger.info(
+        "worker %s: job %s terminal after %d pass(es); computed %d "
+        "block(s), reclaimed %d lease(s)",
+        worker_id, led.job_id, report.passes, report.blocks_computed,
+        report.leases_reclaimed,
+    )
+    return report
+
+
+# ---------------------------------------------------------------------------
+# assembly & introspection
+# ---------------------------------------------------------------------------
+
+
+def wait_job(
+    path: str,
+    fetches,
+    data,
+    *,
+    timeout_s: Optional[float] = None,
+    poll_s: float = 0.5,
+    strict: Optional[bool] = None,
+    trim: bool = False,
+    feed_dict: Optional[Dict[str, str]] = None,
+    constants: Optional[Dict[str, Any]] = None,
+) -> JobResult:
+    """Wait for a (distributed or not) journaled job to reach a
+    terminal state, then assemble and return its
+    :class:`~tensorframes_tpu.engine.jobs.JobResult`.
+
+    Any process may call this — one of the workers, or none of them
+    (the operator's laptop): assembly is the ordinary resume path, so
+    every block restores from its spool, quarantine / strict-mode /
+    torn-tail semantics are identical to the single-worker journal, and
+    the result is byte-identical to a solo run no matter which workers
+    computed which blocks. Raises ``TimeoutError`` after ``timeout_s``
+    (default: wait forever)."""
+    from .jobs import resume_job
+
+    deadline = (
+        time.monotonic() + timeout_s if timeout_s is not None else None
+    )
+    while True:
+        led = None
+        try:
+            led = BlockLedger.open_(path)
+        except FileNotFoundError:
+            pass  # no manifest yet: the first worker hasn't attached
+        if led is not None and _terminal(led):
+            try:
+                return resume_job(
+                    path, fetches, data, strict=strict, trim=trim,
+                    feed_dict=feed_dict, constants=constants,
+                )
+            except StaleLeaseError as e:
+                # terminal journal but a live lease: a worker died (or
+                # is about to exit) between recording its last block
+                # and settling the lease file, or another assembly got
+                # there first. Both clear on their own — keep polling
+                # until the lease expires/releases or the timeout hits.
+                logger.info(
+                    "wait_job: journal terminal but not assemblable "
+                    "yet (%s); polling", e,
+                )
+        if deadline is not None and time.monotonic() > deadline:
+            done = len(led._done) if led is not None else 0
+            total = led.num_blocks if led is not None else 0
+            raise TimeoutError(
+                f"job at {path!r} not terminal after {timeout_s:.1f}s "
+                f"({done}/{total} blocks recorded)"
+            )
+        time.sleep(poll_s)
+
+
+#: journal_status memo: path -> ((ledger mtime_ns, leases-dir
+#: mtime_ns), ledger-derived static fields, raw LeaseViews). A health
+#: probe re-reads the journal only when something actually changed —
+#: every block record touches the ledger, every lease
+#: claim/renewal/release touches the lease directory — so probes
+#: against a finished (or idle) job cost two stat() calls, not a full
+#: ledger replay per hit, forever. Only time-INDEPENDENT data is
+#: cached: live-vs-expired is recomputed from the views' deadlines on
+#: every call, because a lease EXPIRES without any filesystem change
+#: (kill -9 the whole fleet and the stamp never moves — a cached
+#: "live" would misreport a dead fleet as active forever).
+_status_cache: Dict[
+    str, Tuple[Tuple[int, int], Dict[str, Any], List[LeaseView]]
+] = {}
+_status_cache_lock = threading.Lock()
+
+
+def _mtime_ns(p: str) -> int:
+    try:
+        return os.stat(p).st_mtime_ns
+    except OSError:
+        return -1
+
+
+def journal_status(path: str) -> Dict[str, Any]:
+    """Operator view of one journal directory, read from disk — block
+    progress plus the distributed worker/lease table. This is what
+    ``GET /healthz`` embeds (via ``jobs_status``) so ANY process's
+    health endpoint shows the whole fleet draining the manifest, not
+    just its own in-process registry. Memoized on the ledger's and
+    lease directory's mtimes, so repeated probes against an unchanged
+    journal are two ``stat()`` calls."""
+    from .jobs import _LEDGER
+
+    stamp = (
+        _mtime_ns(os.path.join(path, _LEDGER)),
+        _mtime_ns(os.path.join(path, _LEASE_DIR)),
+    )
+    with _status_cache_lock:
+        hit = _status_cache.get(path)
+    if hit is not None and hit[0] == stamp:
+        static, views = hit[1], hit[2]
+    else:
+        try:
+            led = BlockLedger.open_(path)
+        except (FileNotFoundError, KeyError, ValueError):
+            return {"path": path, "manifest": False}
+        plan = led.stored_plan or []
+        static = {
+            "job_id": led.job_id,
+            "op": led.op,
+            "complete": led._complete,
+            "terminal": _terminal(led),
+            "blocks_total": led.num_blocks,
+            "blocks_done": len(led._done),
+            "blocks_quarantined": len(led.quarantined_indices),
+            "blocks_empty": sum(
+                1 for e in plan if int(e.get("rows", 0) or 0) == 0
+            ),
+        }
+        views = LeaseManager(
+            path, worker_id="status-probe", ttl_s=1.0, create=False
+        ).scan_all()
+        with _status_cache_lock:
+            if len(_status_cache) > 8 and path not in _status_cache:
+                _status_cache.pop(next(iter(_status_cache)))
+            _status_cache[path] = (stamp, static, views)
+    # live-vs-expired is classified NOW, from the cached deadlines — a
+    # lease expires without any filesystem change, so this part must
+    # never be served from the cache
+    workers: Dict[str, Dict[str, Any]] = {}
+    leased_live = 0
+    journal_lease = None
+    for v in views:
+        if v.key == _JOURNAL_KEY:
+            if v.state != "done" and not v.expired:
+                journal_lease = {"worker": v.worker,
+                                 "deadline_unix": v.deadline_unix}
+            continue
+        if v.state == "done":
+            continue
+        live = not v.expired
+        leased_live += 1 if live else 0
+        w = workers.setdefault(
+            v.worker or "?",
+            {"worker": v.worker or "?", "live_leases": 0,
+             "stale_leases": 0, "next_deadline_unix": None},
+        )
+        if live:
+            w["live_leases"] += 1
+            nd = w["next_deadline_unix"]
+            w["next_deadline_unix"] = (
+                v.deadline_unix if nd is None else min(nd, v.deadline_unix)
+            )
+        else:
+            w["stale_leases"] += 1
+    return {
+        "path": path,
+        "manifest": True,
+        "job_id": static["job_id"],
+        "op": static["op"],
+        "complete": static["complete"],
+        "terminal": static["terminal"],
+        "blocks": {
+            "total": static["blocks_total"],
+            "done": static["blocks_done"],
+            "quarantined": static["blocks_quarantined"],
+            "leased_live": leased_live,
+            "empty": static["blocks_empty"],
+        },
+        "workers": sorted(
+            workers.values(), key=lambda w: str(w["worker"])
+        ),
+        "journal_lease": journal_lease,
+    }
+
+
+@contextlib.contextmanager
+def journal_guard(path: str, what: str = "resume_job"):
+    """Journal-level mutual exclusion for single-process resume.
+
+    Refuses (:class:`~tensorframes_tpu.utils.failures.StaleLeaseError`)
+    when live block leases exist — a distributed drain is actively
+    computing against this journal, and a resume (above all one
+    clearing ``quarantine.json`` via ``retry_quarantined=True``) would
+    race it — or when another process holds the journal-level lease
+    (two concurrent resumes on one journal). Otherwise takes the
+    journal lease, heartbeats it for the duration, and releases it on
+    exit."""
+    from ..utils import get_config
+
+    lm = LeaseManager(
+        path,
+        worker_id=f"{what}-{_default_worker_id()}",
+        ttl_s=get_config().job_lease_ttl_s,
+    )
+    # acquire the journal lease FIRST, scan block leases SECOND — the
+    # other half of the claim-side handshake (try_acquire re-checks the
+    # journal lease after winning a block): any worker claim either
+    # already shows up in our scan below, or retreats when it sees our
+    # journal lease. No interleaving lets a resume and a drain both
+    # proceed.
+    if lm.try_acquire(None) is None:
+        cur = lm._scan(_JOURNAL_KEY)
+        holder = cur.worker if cur is not None else "?"
+        raise StaleLeaseError(
+            f"{what}: journal at {path!r} is already locked by "
+            f"{holder!r} (another resume or assembly is in progress)"
+        )
+    try:
+        live = lm.live_block_leases()
+        if live:
+            holders = sorted({v.worker for v in live})
+            raise StaleLeaseError(
+                f"{what}: journal at {path!r} has {len(live)} live block "
+                f"lease(s) held by worker(s) {holders}; a distributed "
+                f"drain is active — assemble with wait_job(), or wait "
+                f"for the leases to expire before resuming"
+            )
+        yield lm
+    finally:
+        lm.stop()

@@ -48,16 +48,32 @@ everything around it. This module is the missing durability layer:
 
 Journal layout (``<job_dir>/<job_id>/``)::
 
-    manifest.json            job id, op, fingerprint, row count, block plan
-    blocks/block-00007.npz   spooled fetch arrays for block 7
-    ledger.jsonl             append-only completion / quarantine / event log
-    quarantine.json          current quarantined blocks with their errors
+    manifest.json                   job id, op, fingerprint, row count, plan
+    blocks/block-00007.npz          spooled fetch arrays for block 7
+    ledger.jsonl                    append-only completion / quarantine log
+    quarantine.json                 current quarantined blocks + errors
+    leases/block-00007.e000002.lease  block 7's lease at fencing epoch 2
+    leases/journal.e000000.lease    journal-level lease (resume/assembly)
+
+The ``leases/`` directory belongs to the **distributed** drain layer
+(``engine/dist_jobs.py``): K independent worker processes attach to one
+journal and drain one manifest concurrently, coordinator-free — atomic
+per-block leasing (O_EXCL epoch files), heartbeat renewal, dead-worker
+reclamation (epoch bump + byte-identical recompute, exactly the resume
+path), and **write fencing**: every spool write and ledger append
+carries the writer's ``(worker_id, epoch)``, a zombie whose lease was
+stolen fails its late write with
+:class:`~tensorframes_tpu.utils.failures.StaleLeaseError`, and replay
+ignores any done-record superseded by a higher epoch. Single-process
+jobs never create ``leases/``; ``resume_job`` takes the journal-level
+lease so a resume cannot race an active distributed drain.
 
 Chaos sites ``jobs.block`` (per-block execution — a ``fatal`` kind is
 the poison-block drill) and ``jobs.journal_write`` (the spool+append
 path — a ``fatal`` there simulates a crash between computing a block
 and recording it) drive the whole subsystem under the deterministic
-harness; see docs/fault_tolerance.md.
+harness — plus ``jobs.lease`` / ``jobs.heartbeat`` on the distributed
+paths; see docs/fault_tolerance.md.
 """
 
 from __future__ import annotations
@@ -106,6 +122,12 @@ _m_resumes = _counter(
 _m_quarantined = _counter(
     "jobs.quarantined_total", "Blocks quarantined across all batch jobs"
 )
+_m_fence_rejects = _counter(
+    "jobs.fence_rejects_total",
+    "Journal writes rejected by the lease fence: a worker whose block "
+    "lease was reclaimed (stale epoch) tried to record late, or a "
+    "superseded record was ignored on replay",
+)
 
 _OPS = ("map_rows", "map_blocks", "reduce_blocks", "aggregate")
 
@@ -125,7 +147,10 @@ def _default_job_dir() -> str:
 
 
 def _atomic_write(path: str, data: bytes) -> None:
-    tmp = path + ".tmp"
+    # unique tmp name: concurrent distributed workers may write the
+    # same manifest (identical content) at the same instant, and a
+    # shared tmp path would make one rename fail under the other
+    tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()
@@ -226,6 +251,9 @@ class BlockLedger:
         #: block index -> spool relpath (disk, lazily loaded) or the
         #: result arrays themselves (memory mode / after load)
         self._done: Dict[int, Any] = {}
+        #: block index -> fencing epoch of its surviving done-record
+        #: (0 for single-process records, which carry no tag)
+        self._done_epoch: Dict[int, int] = {}
         self._quar: Dict[int, QuarantinedBlock] = {}
         self._restored = 0
         self._computed = 0
@@ -287,9 +315,26 @@ class BlockLedger:
             elif rec.get("event") == "quarantine_cleared":
                 led._quar.clear()
             elif rec.get("status") == "done":
+                blk = int(rec["block"])
+                epoch = int(rec.get("epoch", 0))
+                prev = led._done_epoch.get(blk)
+                if prev is not None and epoch < prev:
+                    # replay-side fence: a zombie's late append that
+                    # slipped past the write fence is superseded by the
+                    # reclaimer's higher-epoch record (both byte-
+                    # identical by determinism; the arbitration keeps
+                    # the journal's story single-writer per block)
+                    _m_fence_rejects.inc()
+                    logger.warning(
+                        "job %s: ignoring stale done-record for block %d "
+                        "(epoch %d < %d, worker %s)",
+                        led.job_id, blk, epoch, prev, rec.get("worker"),
+                    )
+                    continue
                 spool = os.path.join(path, rec["npz"])
                 if os.path.exists(spool):
-                    led._done[int(rec["block"])] = rec["npz"]
+                    led._done[blk] = rec["npz"]
+                    led._done_epoch[blk] = epoch
                 else:
                     logger.warning(
                         "job %s: block %s has a completion record but no "
@@ -464,6 +509,38 @@ class BlockLedger:
         self._record_done(i, res, rows)
         return res
 
+    # -- distributed-drain hooks (overridden by engine/dist_jobs.py) -------
+
+    def _writer_tag(self, i: int) -> Dict[str, Any]:
+        """Identity stamped into block ``i``'s journal records. The
+        distributed ledger returns ``{"worker": ..., "epoch": ...}`` —
+        the write-fencing token; single-process records carry none (and
+        replay treats them as epoch 0)."""
+        return {}
+
+    def _fence_check(self, i: int) -> None:
+        """Write fence, called INSIDE the journal writer immediately
+        before block ``i``'s spool rename + ledger append. The
+        distributed ledger verifies this worker still holds block
+        ``i``'s lease at its claimed epoch and raises
+        :class:`~tensorframes_tpu.utils.failures.StaleLeaseError`
+        otherwise; single-process jobs have nothing to fence."""
+
+    def _on_recorded(self, i: int, done: bool = True) -> None:
+        """Called INSIDE the journal writer right after block ``i``'s
+        record landed — the distributed ledger settles the block's
+        lease here (never earlier: a lease settled before the record
+        lands would let another worker recompute and double-record).
+        ``done`` distinguishes a completion record (the lease becomes a
+        terminal marker) from a quarantine record (the lease is
+        released so ``retry_quarantined`` drains can re-claim)."""
+
+    def _spool_tmp_suffix(self) -> str:
+        """Disambiguates spool tmp names: concurrent workers writing
+        block tmp files into one ``blocks/`` directory must never share
+        a tmp path (the final rename target is the same by design)."""
+        return ""
+
     def _journal_write(self, fn: Callable[[], None], what: str) -> None:
         """All journal mutations funnel through here: the chaos site
         sits inside the retry window, so injected transients exercise
@@ -594,9 +671,14 @@ class BlockLedger:
         if self.path is not None:
             rel = os.path.join(_BLOCK_DIR, f"block-{i:05d}.npz")
             final = os.path.join(self.path, rel)
+            # the fencing token is captured NOW (while this worker still
+            # believes it owns the block); the fence re-validates it at
+            # actual write time, inside the writer thread
+            tag = self._writer_tag(i)
 
             def write():
-                tmp = final + ".tmp.npz"
+                self._fence_check(i)
+                tmp = final + f".tmp{self._spool_tmp_suffix()}.npz"
                 with open(tmp, "wb") as f:
                     # keys are prefixed so a fetch named "file" (or any
                     # other np.savez parameter name) cannot collide with
@@ -606,9 +688,11 @@ class BlockLedger:
                     )
                 os.replace(tmp, final)
                 self._append(
-                    {"block": i, "status": "done", "npz": rel, "rows": rows}
+                    {"block": i, "status": "done", "npz": rel,
+                     "rows": rows, **tag}
                 )
                 counted()
+                self._on_recorded(i)
 
             self._enqueue(write, what="jobs journal-write")
             self._done[i] = rel
@@ -645,10 +729,14 @@ class BlockLedger:
             if qb.error else "",
         )
         if self.path is not None:
+            tag = self._writer_tag(i)
+
             def write():
+                self._fence_check(i)
                 self._append({"status": "quarantined", **qb.as_dict(),
-                              "block": i})
+                              "block": i, **tag})
                 self._write_quarantine_manifest()
+                self._on_recorded(i, done=False)
 
             self._enqueue(write, what="jobs quarantine-write")
 
@@ -767,6 +855,7 @@ def _register_start(ledger: BlockLedger, resumed: bool) -> None:
         _active[ledger.job_id] = {
             "job_id": ledger.job_id,
             "op": ledger.op,
+            "path": ledger.path,
             "resumed": resumed,
             "started_unix": time.time(),
         }
@@ -790,9 +879,16 @@ def _register_end(ledger: BlockLedger, ok: bool) -> None:
 def jobs_status() -> Dict[str, Any]:
     """Point-in-time batch-job summary for this process — embedded in
     the scoring server's ``GET /healthz`` payload so operators see batch
-    health next to serving health."""
+    health next to serving health.
+
+    For a *journaled* job (active here, or the last one finished), the
+    summary additionally carries a ``"journal"`` view read from the
+    journal directory itself — block progress plus the distributed
+    worker/lease table (``engine/dist_jobs.py``) — so an operator
+    probing ANY process's ``/healthz`` sees the whole fleet draining
+    the manifest, not just this process's registry."""
     with _status_lock:
-        return {
+        status = {
             "active": len(_active),
             "runs_total": _totals["runs"],
             "completed_total": _totals["completed"],
@@ -800,6 +896,19 @@ def jobs_status() -> Dict[str, Any]:
             "resumes_total": _totals["resumes"],
             "last": dict(_last) if _last else None,
         }
+        path = None
+        for info in _active.values():
+            path = info.get("path") or path
+        if path is None and _last:
+            path = _last.get("path")
+    if path is not None:
+        try:
+            from .dist_jobs import journal_status
+
+            status["journal"] = journal_status(path)
+        except Exception:  # health must never fail over a disk probe
+            status["journal"] = None
+    return status
 
 
 # ---------------------------------------------------------------------------
@@ -995,16 +1104,27 @@ def resume_job(
     Completed blocks restore from their spools; only unfinished blocks
     recompute, and the final output is byte-identical to a clean run.
     ``retry_quarantined=True`` clears quarantine records first so
-    poisoned blocks re-attempt (after an upstream fix)."""
-    ledger = BlockLedger.open_(path)
-    if retry_quarantined:
-        ledger.clear_quarantine()
-    _m_resumes.inc()
-    if strict is None:
-        from ..utils import get_config
+    poisoned blocks re-attempt (after an upstream fix).
 
-        strict = not get_config().quarantine_blocks
-    return _drive(
-        ledger, fetches, data, strict=strict, trim=trim,
-        feed_dict=feed_dict, constants=constants, resumed=True,
-    )
+    A resume takes the **journal-level lease** for its duration and
+    refuses (:class:`~tensorframes_tpu.utils.failures.StaleLeaseError`)
+    while distributed workers hold live block leases on this journal —
+    in particular, ``retry_quarantined=True`` clearing
+    ``quarantine.json`` under an active drain would race the live job.
+    Use :func:`~tensorframes_tpu.engine.dist_jobs.wait_job` to assemble
+    a distributed job's result instead."""
+    from .dist_jobs import journal_guard
+
+    with journal_guard(path, what="resume_job"):
+        ledger = BlockLedger.open_(path)
+        if retry_quarantined:
+            ledger.clear_quarantine()
+        _m_resumes.inc()
+        if strict is None:
+            from ..utils import get_config
+
+            strict = not get_config().quarantine_blocks
+        return _drive(
+            ledger, fetches, data, strict=strict, trim=trim,
+            feed_dict=feed_dict, constants=constants, resumed=True,
+        )

@@ -181,6 +181,31 @@ def chunk_rows(row_bytes: int) -> int:
     return max(1, int(chunk_bytes // row_bytes))
 
 
+def _submit(pool, fn, *args):
+    """Submit a transfer task carrying the submitting thread's retry
+    deadline into the pool thread. The distributed-job worker clips
+    each block's retry budget below its lease TTL via a thread-local
+    ``retry_deadline`` window (``utils/failures.py``); chunk transfers
+    run their ``run_with_retries`` windows on pool threads, where that
+    thread-local would otherwise be unset — i.e. unbounded, letting a
+    transient burst on the link retry past the TTL while the worker is
+    alive and mid-block (presumed dead, fenced)."""
+    from ..utils.failures import (
+        adopt_retry_deadline,
+        current_retry_deadline,
+    )
+
+    deadline = current_retry_deadline()
+    if deadline is None:
+        return pool.submit(fn, *args)
+
+    def run(*a):
+        with adopt_retry_deadline(deadline):
+            return fn(*a)
+
+    return pool.submit(run, *args)
+
+
 def _observed(direction: str, fn, what: str):
     """Run one chunk transfer inside its retry window with the chaos
     site, inflight gauge, latency histogram, and byte counter applied.
@@ -291,7 +316,8 @@ class StreamingUpload:
         self._lock = threading.Lock()
         pool = _get_pool()
         self._futs = [
-            pool.submit(
+            _submit(
+                pool,
                 _put_chunk,
                 arr[lo:hi] if arr.ndim else arr,
                 self.wire,
@@ -459,8 +485,9 @@ def d2h_async(dev, what: str = "column"):
             return arr, arr.nbytes
 
         return _WholeFetch(
-            _get_pool().submit(
-                _observed, "d2h", fetch_whole, f"frame.d2h {what}"
+            _submit(
+                _get_pool(),
+                _observed, "d2h", fetch_whole, f"frame.d2h {what}",
             )
         )
     out = np.empty(shape, dtype)
@@ -476,7 +503,8 @@ def d2h_async(dev, what: str = "column"):
 
     pool = _get_pool()
     futs = [
-        pool.submit(fetch, i, lo, hi) for i, (lo, hi) in enumerate(bounds)
+        _submit(pool, fetch, i, lo, hi)
+        for i, (lo, hi) in enumerate(bounds)
     ]
     return _PendingFetch(out, futs)
 

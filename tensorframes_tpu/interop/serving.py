@@ -433,7 +433,11 @@ class ScoringServer:
         AGGREGATE with per-replica detail, 200 while any replica serves
         — plus this process's batch-job summary (``engine/jobs.py``:
         active/completed/failed runs, the last job's block counts and
-        quarantine tally) so operators see batch health next to serving
+        quarantine tally; for a journaled job, the ``"journal"`` view
+        read from the journal directory itself — block progress and the
+        distributed worker/lease table of ``engine/dist_jobs.py``, so
+        ANY process's probe shows the whole fleet draining the
+        manifest) so operators see batch health next to serving
         health. A server with no engine is just an Arrow scorer —
         always healthy as long as it accepts connections. A 503 carries
         the adaptive ``Retry-After`` so probes and balancers know when

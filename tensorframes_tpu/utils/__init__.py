@@ -12,8 +12,10 @@ from .failures import (
     DeadlineExceededError,
     DeviceOOMError,
     QuarantinedBlocksError,
+    StaleLeaseError,
     is_oom,
     is_transient,
+    retry_deadline,
     run_with_retries,
     seed_backoff_jitter,
 )
@@ -30,8 +32,10 @@ __all__ = [
     "DeadlineExceededError",
     "DeviceOOMError",
     "QuarantinedBlocksError",
+    "StaleLeaseError",
     "is_oom",
     "is_transient",
+    "retry_deadline",
     "run_with_retries",
     "seed_backoff_jitter",
     "chaos",

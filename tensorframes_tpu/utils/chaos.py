@@ -22,6 +22,13 @@ Named **injection sites** sit on the host-side dispatch paths:
 - ``jobs.journal_write`` — inside the job journal's write path (npz
   spool + ledger append): a ``fatal`` here simulates a crash between
   computing a block and recording it (the kill-and-resume drill)
+- ``jobs.lease`` — inside a distributed-job worker's lease
+  claim/reclaim path (``engine/dist_jobs.py``): a ``transient`` retries
+  the claim; a ``fatal`` is the worker-dies-while-claiming drill
+- ``jobs.heartbeat`` — inside the lease heartbeat renewal: ``latency``
+  past the lease TTL is the presumed-dead drill (the lease expires and
+  another worker reclaims the block; the stalled owner's late write is
+  then fence-rejected)
 - ``frame.h2d`` / ``frame.d2h`` — inside every streaming-transfer
   chunk's retry window (``frame/transfer.py``): a ``transient`` here is
   the flaky-tunnel-during-ingest drill (one chunk retries; the column
@@ -126,6 +133,8 @@ SITES = (
     "serving.conn",
     "jobs.block",
     "jobs.journal_write",
+    "jobs.lease",
+    "jobs.heartbeat",
     "frame.h2d",
     "frame.d2h",
     "fleet.place",

@@ -118,6 +118,19 @@ class Config:
     #: keeps the job's block loop and quarantine semantics but writes
     #: nothing to disk — the overhead-comparison / test mode.
     journal_batch_jobs: bool = True
+    #: distributed batch jobs (``engine/dist_jobs.py``): how long a
+    #: worker's block lease stays valid without a heartbeat renewal.
+    #: The liveness-vs-safety knob — a crashed worker's blocks are
+    #: reclaimable only after this long, but a *live* worker whose
+    #: heartbeats stall longer than this is presumed dead and its block
+    #: stolen (the late write is then fence-rejected). Must comfortably
+    #: exceed worst-case heartbeat jitter + filesystem latency + clock
+    #: skew between workers. Per-worker override: ``run_worker(lease_ttl_s=)``.
+    job_lease_ttl_s: float = 30.0
+    #: heartbeat renewal interval for held leases. ``0`` (default)
+    #: means ``job_lease_ttl_s / 3`` — three chances to renew before
+    #: expiry. Per-worker override: ``run_worker(heartbeat_s=)``.
+    job_heartbeat_s: float = 0.0
     #: default quarantine policy for batch jobs: True returns partial
     #: results (``JobResult.completed`` + ``.quarantined``) when a block
     #: fails deterministically; False (strict) raises

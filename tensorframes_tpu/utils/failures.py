@@ -32,14 +32,18 @@ from __future__ import annotations
 
 import random
 import re
+import threading
 import time
 from typing import Callable, Iterator, Optional, TypeVar
 
 from .logging import get_logger
 
 __all__ = [
+    "adopt_retry_deadline",
+    "current_retry_deadline",
     "is_oom",
     "is_transient",
+    "retry_deadline",
     "run_with_retries",
     "record_oom_split",
     "record_preemption",
@@ -48,6 +52,7 @@ __all__ = [
     "DeviceOOMError",
     "PagePoolExhausted",
     "QuarantinedBlocksError",
+    "StaleLeaseError",
 ]
 
 logger = get_logger("failures")
@@ -170,6 +175,28 @@ class QuarantinedBlocksError(RuntimeError):
         self.blocks = list(blocks)
 
 
+class StaleLeaseError(RuntimeError):
+    """A distributed-job write was fenced off, or a journal is busy.
+
+    Raised by the distributed batch-job layer (``engine/dist_jobs.py``)
+    in two situations that share one meaning — *this process does not
+    own the journal state it is about to mutate*:
+
+    - a worker whose block lease expired and was **reclaimed** by
+      another worker (epoch bumped) tries to record its late result:
+      the write fence rejects the spool/ledger mutation, so a zombie
+      can never land a torn or duplicate block record;
+    - :func:`~tensorframes_tpu.engine.jobs.resume_job` is asked to
+      touch a journal that live workers are still draining (or another
+      resume holds the journal-level lease).
+
+    Deliberately **non-transient**: retrying cannot help — the lease is
+    gone (another worker owns the block now; its recompute is
+    byte-identical) or the journal is owned by someone alive. The
+    remedy is to move on to the next block / wait for the drain, never
+    to retry the fenced write."""
+
+
 class DeadlineExceededError(TimeoutError):
     """A generation request outlived its caller-supplied deadline and was
     evicted by the serving scheduler (queued or mid-generation). A
@@ -187,7 +214,13 @@ def is_oom(e: BaseException) -> bool:
 
 
 def is_transient(e: BaseException) -> bool:
-    if isinstance(e, DeadlineExceededError) or is_oom(e):
+    # explicitly-terminal types veto the text markers anywhere in the
+    # chain: a StaleLeaseError raised `from` an UNAVAILABLE cause must
+    # not inherit that cause's retryability — the lease is gone
+    if any(
+        isinstance(x, (DeadlineExceededError, StaleLeaseError))
+        for x in _exc_chain(e)
+    ) or is_oom(e):
         return False
     s = _exc_text(e)
     return any(m in s for m in _TRANSIENT_MARKERS)
@@ -225,6 +258,92 @@ def seed_backoff_jitter(seed: Optional[int]) -> None:
     _jitter_rng = random.Random(seed)
 
 
+#: thread-local retry-deadline window (absolute time.monotonic() value):
+#: :class:`retry_deadline` installs it so every ``run_with_retries``
+#: window reached from the calling thread — however deep in the engine —
+#: is bounded without threading a parameter through every call site
+_retry_deadline_tl = threading.local()
+
+
+class retry_deadline:
+    """Bound every ``run_with_retries`` window entered from this thread
+    to a wall-clock budget::
+
+        with retry_deadline(lease_ttl_s * 0.8):
+            ledger.run_block(i, compute)   # retries stop before the TTL
+
+    The distributed-job worker wraps each block's compute in this so a
+    retrying-but-alive lease holder gives up (and lets the job fail
+    resumable / the block be retried next pass) *before* its lease
+    deadline passes — otherwise a long transient burst would eat the
+    whole TTL mid-retry, the worker would be presumed dead, and its
+    block stolen while it still intended to write. Nests: the inner
+    window is clipped to the outer one. ``None``/``<= 0`` is a no-op."""
+
+    def __init__(self, seconds: Optional[float]):
+        self._seconds = seconds
+        self._prev: Optional[float] = None
+
+    def __enter__(self) -> "retry_deadline":
+        self._prev = getattr(_retry_deadline_tl, "deadline", None)
+        if self._seconds is not None and self._seconds > 0:
+            mine = time.monotonic() + self._seconds
+            _retry_deadline_tl.deadline = (
+                mine if self._prev is None else min(mine, self._prev)
+            )
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _retry_deadline_tl.deadline = self._prev
+
+
+def current_retry_deadline() -> Optional[float]:
+    """The calling thread's absolute retry deadline (``time.monotonic``
+    scale) installed by :class:`retry_deadline`, or ``None``. Layers
+    that hand work to a thread pool capture this at submit time and
+    re-install it in the pool thread with :class:`adopt_retry_deadline`
+    — a thread-local does not cross executor boundaries on its own, and
+    a retry window running unbounded on a pool thread would defeat the
+    lease-TTL clipping the window exists for (``engine/dist_jobs.py``)."""
+    return getattr(_retry_deadline_tl, "deadline", None)
+
+
+class adopt_retry_deadline:
+    """Install an ABSOLUTE deadline (from :func:`current_retry_deadline`)
+    in this thread for the duration; clips to any window already
+    present. ``None`` is a no-op."""
+
+    def __init__(self, deadline: Optional[float]):
+        self._deadline = deadline
+        self._prev: Optional[float] = None
+
+    def __enter__(self) -> "adopt_retry_deadline":
+        self._prev = getattr(_retry_deadline_tl, "deadline", None)
+        if self._deadline is not None:
+            _retry_deadline_tl.deadline = (
+                self._deadline
+                if self._prev is None
+                else min(self._deadline, self._prev)
+            )
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _retry_deadline_tl.deadline = self._prev
+
+
+def _effective_retry_deadline(
+    deadline_s: Optional[float],
+) -> Optional[float]:
+    """Absolute monotonic deadline for one retry window: the explicit
+    ``deadline_s`` argument and the thread-local :class:`retry_deadline`
+    window, whichever ends first."""
+    deadline = getattr(_retry_deadline_tl, "deadline", None)
+    if deadline_s is not None and deadline_s > 0:
+        mine = time.monotonic() + deadline_s
+        deadline = mine if deadline is None else min(mine, deadline)
+    return deadline
+
+
 def _backoff_delay(attempt: int, base: float) -> float:
     """Full-jitter exponential backoff: uniform over
     ``(0.05 * cap, cap]`` where ``cap = base * 2**n``.
@@ -239,25 +358,58 @@ def _backoff_delay(attempt: int, base: float) -> float:
     return _jitter_rng.uniform(0.05 * cap, cap)
 
 
-def run_with_retries(fn: Callable[[], T], what: str = "device dispatch") -> T:
+def run_with_retries(
+    fn: Callable[[], T],
+    what: str = "device dispatch",
+    deadline_s: Optional[float] = None,
+) -> T:
     """Run ``fn``, retrying transient runtime failures with full-jitter
     exponential backoff per the config (``max_retries`` /
     ``retry_backoff_s``; see :func:`_backoff_delay`). Raises the last
     error when attempts run out; non-transient errors propagate
-    immediately."""
+    immediately.
+
+    ``deadline_s`` (and/or an enclosing :class:`retry_deadline` window —
+    the tighter bound wins) caps the *wall clock* the retry loop may
+    consume: a retry whose backoff sleep would land past the deadline is
+    not attempted and the last transient error raises instead. The
+    attempt in progress is never interrupted — this bounds the loop, not
+    the dispatch."""
     from .config import get_config
 
     cfg = get_config()
+    deadline = _effective_retry_deadline(deadline_s)
     attempt = 0
     while True:
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 — classified below
-            if not is_transient(e) or attempt >= cfg.max_retries:
+            out_of_time = deadline is not None and (
+                time.monotonic() >= deadline
+            )
+            if (
+                not is_transient(e)
+                or attempt >= cfg.max_retries
+                or out_of_time
+            ):
                 if is_transient(e):
                     _retries_exhausted_total.inc(op=_op_label(what))
+                    if out_of_time:
+                        logger.warning(
+                            "%s: retry deadline reached after %d "
+                            "attempt(s); giving up on the transient error",
+                            what, attempt + 1,
+                        )
                 raise
             delay = _backoff_delay(attempt, cfg.retry_backoff_s)
+            if deadline is not None and time.monotonic() + delay >= deadline:
+                _retries_exhausted_total.inc(op=_op_label(what))
+                logger.warning(
+                    "%s: backoff of %.2fs would pass the retry deadline; "
+                    "giving up after %d attempt(s)",
+                    what, delay, attempt + 1,
+                )
+                raise
             attempt += 1
             _retries_total.inc(op=_op_label(what), reason=_failure_reason(e))
             # split, not splitlines: an exception classified off its CAUSE
