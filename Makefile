@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost verify bench bench-serve bench-attn bench-jobs bench-ingest bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs verify bench bench-serve bench-attn bench-jobs bench-ingest bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -62,6 +62,13 @@ test-distjobs:
 # tier-1; the multi-replica chaos soak is marked slow and runs here too
 test-fleet:
 	$(PY) -m pytest tests/ -q -m fleet
+
+# the observability suite (tensorframes_tpu/obs: metrics registry
+# semantics, distributed tracing end-to-end, flight recorder + debug
+# bundles, /statusz, the docs<->code drift gate) — CPU-only,
+# deterministic, tier-1
+test-obs:
+	$(PY) -m pytest tests/ -q -m obs
 
 # just the real 2-process distributed suite
 test-multihost:

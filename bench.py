@@ -553,6 +553,14 @@ def main_decode_serve():
         rep_levels[str(r)] = _serve_fleet_aggregate(
             lm, r, plen=plen, max_new=max_new, seed=100 + r
         )
+    # observability-cost axis (ISSUE 10): the same per-request shape
+    # with tracing LIVE (JSONL sink attached — every span on the
+    # prefill/decode path materializes and serializes) vs the TFT_OBS=0
+    # kill switch, interleaved best-of. The trajectory tracks what the
+    # layer costs; the budget is <= 1% (this tiny CPU model is the
+    # WORST case for the pct — real-chip step times dwarf the ~µs span
+    # cost)
+    observability = _serve_obs_overhead(lm, plen=plen, max_new=16)
     from tensorframes_tpu.utils import chaos
 
     print(
@@ -574,6 +582,7 @@ def main_decode_serve():
                     "attention_impl": attention,
                     "shared_prefix": shared_prefix,
                     "replicas": rep_levels,
+                    "observability": observability,
                     # a chaos-tainted number must never be mistaken for a
                     # clean one (the injection sites sit on this path; the
                     # disabled check is the measured-as-free case)
@@ -582,6 +591,54 @@ def main_decode_serve():
             }
         )
     )
+
+
+def _serve_obs_overhead(lm, plen, max_new, iters=3):
+    """tokens/s with the tracing layer live vs killed: best-of
+    ``iters`` interleaved runs of the concurrency-4 workload, one with
+    a JSONL span sink attached, one under ``observability=False`` (the
+    runtime equivalent of ``TFT_OBS=0``)."""
+    import os
+    import shutil
+    import tempfile
+
+    from tensorframes_tpu import obs
+    from tensorframes_tpu.utils import get_config, set_config
+
+    root = tempfile.mkdtemp(prefix="tft-bench-obs-")
+    sink = os.path.join(root, "trace.jsonl")
+    # the axis FORCES each leg's state; the operator's own setting
+    # (e.g. an outer TFT_OBS=0 smoke run) is restored afterwards
+    prev_obs = get_config().observability
+    on = off = 0.0
+    try:
+        for i in range(iters):
+            set_config(observability=True)
+            obs.set_trace_sink(sink)
+            try:
+                on = max(
+                    on,
+                    _serve_one_concurrency(
+                        lm, 4, plen=plen, max_new=max_new, seed=7000 + i
+                    )["tokens_per_sec"],
+                )
+            finally:
+                obs.set_trace_sink(None)
+            set_config(observability=False)
+            off = max(
+                off,
+                _serve_one_concurrency(
+                    lm, 4, plen=plen, max_new=max_new, seed=8000 + i
+                )["tokens_per_sec"],
+            )
+    finally:
+        set_config(observability=prev_obs)
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "tracing_on_tokens_per_sec": round(on, 2),
+        "obs_off_tokens_per_sec": round(off, 2),
+        "overhead_pct": round((off - on) / off * 100.0, 2) if off else None,
+    }
 
 
 def main_paged_attn():
@@ -767,6 +824,33 @@ def main_map_rows_journal():
         dt_off = min(dt_off, one(False, i))
         dt_on = min(dt_on, one(True, i + iters))
     blocks = one.blocks
+    # observability-cost axis (ISSUE 10): the in-memory workload with
+    # tracing LIVE (JSONL sink attached — the engine.map_rows /
+    # jobs.block spans all materialize) vs the TFT_OBS=0 kill switch,
+    # interleaved best-of like the journal pair. Acceptance: <= 1%
+    # overhead on this microbench.
+    import os as _os
+
+    from tensorframes_tpu import obs as _obs
+
+    obs_sink = _os.path.join(job_root, "bench-trace.jsonl")
+    # the axis FORCES each leg's state; the operator's own setting
+    # (e.g. an outer TFT_OBS=0 smoke run) is restored afterwards
+    prev_obs = get_config().observability
+    dt_obs_on = dt_obs_off = float("inf")
+    try:
+        for i in range(iters):
+            set_config(observability=True)
+            _obs.set_trace_sink(obs_sink)
+            try:
+                dt_obs_on = min(dt_obs_on, one(False, 100 + i))
+            finally:
+                _obs.set_trace_sink(None)
+            set_config(observability=False)
+            dt_obs_off = min(dt_obs_off, one(False, 200 + i))
+    finally:
+        set_config(observability=prev_obs)
+    obs_overhead_pct = (dt_obs_on - dt_obs_off) / dt_obs_off * 100.0
     set_config(max_rows_per_device_call=old_chunk)
     workers_axis = _bench_job_workers(n_rows, width, job_root)
     shutil.rmtree(job_root, ignore_errors=True)
@@ -787,6 +871,15 @@ def main_map_rows_journal():
                     "journal_off_rows_per_sec": round(n_rows / dt_off, 1),
                     "journal_on_rows_per_sec": round(n_rows / dt_on, 1),
                     "journal_overhead_pct": round(overhead_pct, 2),
+                    "observability": {
+                        "tracing_on_rows_per_sec": round(
+                            n_rows / dt_obs_on, 1
+                        ),
+                        "obs_off_rows_per_sec": round(
+                            n_rows / dt_obs_off, 1
+                        ),
+                        "overhead_pct": round(obs_overhead_pct, 2),
+                    },
                     "seconds_per_job": {
                         "journal_off": round(dt_off, 4),
                         "journal_on": round(dt_on, 4),

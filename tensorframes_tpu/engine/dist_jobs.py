@@ -82,7 +82,13 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..obs import span as _span
+from ..obs import (
+    event as _trace_event,
+    flight as _flight,
+    new_trace as _new_trace,
+    span as _span,
+    use_trace as _use_trace,
+)
 from ..obs.metrics import counter as _counter, gauge as _gauge
 from ..utils import get_logger
 from ..utils.failures import (
@@ -378,9 +384,25 @@ class LeaseManager:
             with self._lock:
                 self._held[key] = (epoch, fname)
             self._ensure_heartbeat()
+            if key != _JOURNAL_KEY:
+                # a POINT event, written to the trace sink immediately:
+                # the claim survives this worker's kill -9 — the record
+                # that lets a post-mortem show claim -> reclaim ->
+                # record as one trace across processes and epochs
+                _trace_event(
+                    "jobs.lease.claim",
+                    block=block,
+                    epoch=epoch,
+                    worker=self.worker_id,
+                    reclaim=reclaim,
+                )
             if reclaim and key != _JOURNAL_KEY:
                 _m_reclaims.inc()
                 self.reclaimed_total += 1
+                _flight.record(
+                    "jobs", "lease_reclaim", block=key, epoch=epoch,
+                    worker=self.worker_id, prev_worker=cur.worker,
+                )
                 logger.warning(
                     "worker %s reclaimed %s at epoch %d from presumed-dead "
                     "worker %s (lease expired %.1fs ago); recomputing",
@@ -514,6 +536,20 @@ class LeaseManager:
                     f"superseded by epoch {cur.epoch} "
                     f"(worker {cur.worker!r}, state {cur.state})"
                 )
+            _flight.record(
+                "fences", "fence_reject", block=block, epoch=epoch,
+                worker=self.worker_id, detail=detail,
+            )
+            _flight.dump_bundle(
+                "fence_reject",
+                debounce_key=f"{block}",
+                extra={
+                    "block": block,
+                    "epoch": epoch,
+                    "worker": self.worker_id,
+                    "detail": detail,
+                },
+            )
             raise StaleLeaseError(
                 f"worker {self.worker_id}: block {block} lease at epoch "
                 f"{epoch} is stale — {detail}; dropping the late write "
@@ -624,6 +660,10 @@ class _DistLedger(BlockLedger):
         epoch = self._owned.get(i)
         if epoch is None:
             _m_fence_rejects.inc()
+            _flight.record(
+                "fences", "fence_reject", block=i,
+                worker=self._lm.worker_id, detail="no lease held",
+            )
             raise StaleLeaseError(
                 f"worker {self._lm.worker_id}: no lease held for block "
                 f"{i}; refusing the unfenced journal write"
@@ -821,8 +861,13 @@ def run_worker(
                 ok = True
                 break
             led._bind(lm, retry_deadline_s=ttl * retry_deadline_frac)
+            if led._trace is None:
+                # first worker on a journal with no manifest yet: mint
+                # the job trace so ensure_plan stamps it; later passes
+                # (and every other worker) adopt it from the manifest
+                led._trace = _new_trace()
             try:
-                with _span(
+                with _use_trace(led._trace), _span(
                     "jobs.worker_pass", job=led.job_id, worker=worker_id
                 ):
                     _execute(

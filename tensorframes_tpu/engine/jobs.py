@@ -88,11 +88,19 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..obs import span as _span
+from ..obs import (
+    TraceContext,
+    current_trace as _current_trace,
+    flight as _flight,
+    new_trace as _new_trace,
+    span as _span,
+    use_trace as _use_trace,
+)
 from ..obs.metrics import counter as _counter
 from ..utils import get_logger
 from ..utils.failures import (
     QuarantinedBlocksError,
+    first_line,
     is_oom,
     is_transient,
     run_with_retries,
@@ -160,13 +168,17 @@ def _atomic_write(path: str, data: bytes) -> None:
 
 @dataclasses.dataclass
 class QuarantinedBlock:
-    """One poisoned block: its plan position and the real error."""
+    """One poisoned block: its plan position, the real error, and the
+    flight recorder's debug bundle for the failure (``debug_bundle`` —
+    a path on the quarantining host; empty when observability was off
+    or the dump failed)."""
 
     index: int
     rows: Optional[int]
     error_type: str
     error: str
     traceback: str = ""
+    debug_bundle: str = ""
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -179,6 +191,7 @@ class QuarantinedBlock:
             error_type=d.get("error_type", ""),
             error=d.get("error", ""),
             traceback=d.get("traceback", ""),
+            debug_bundle=d.get("debug_bundle", ""),
         )
 
 
@@ -258,6 +271,15 @@ class BlockLedger:
         self._restored = 0
         self._computed = 0
         self._complete = False
+        #: the job's TraceContext: stamped into the manifest on a fresh
+        #: job, adopted FROM the manifest on resume/attach — one
+        #: trace_id follows the job across processes, workers, and
+        #: epochs (docs/observability.md)
+        self._trace: Optional[TraceContext] = None
+        #: block index -> (trace_id, span_id) of its jobs.block span,
+        #: stamped into the block's ledger record so the journal alone
+        #: reconstructs which trace computed what
+        self._block_trace: Dict[int, Tuple[Optional[str], Optional[str]]] = {}
         self._ledger_file = None
         #: background journal writer: block i's spool overlaps block
         #: i+1's compute (the decode-prefetch idiom); errors park in
@@ -297,6 +319,13 @@ class BlockLedger:
         led = cls(path, manifest["job_id"], manifest["op"])
         led._manifest = manifest
         led._plan = manifest["plan"]
+        if manifest.get("trace_id"):
+            # continue the original run's trace (resumes and distributed
+            # workers parent their spans to the job's root)
+            led._trace = TraceContext(
+                manifest["trace_id"],
+                manifest.get("trace_span_id") or "0" * 16,
+            )
         try:
             with open(os.path.join(path, _LEDGER), "rb") as f:
                 lines = f.read().decode("utf-8", "replace").splitlines()
@@ -325,6 +354,11 @@ class BlockLedger:
                     # identical by determinism; the arbitration keeps
                     # the journal's story single-writer per block)
                     _m_fence_rejects.inc()
+                    _flight.record(
+                        "fences", "stale_record", job=led.job_id,
+                        block=blk, epoch=epoch, superseded_by=prev,
+                        worker=str(rec.get("worker")),
+                    )
                     logger.warning(
                         "job %s: ignoring stale done-record for block %d "
                         "(epoch %d < %d, worker %s)",
@@ -427,6 +461,28 @@ class BlockLedger:
             "fingerprint": fp,
             "plan": entries,
         }
+        if self.path is not None and self._trace is not None:
+            # two workers can race a FRESH journal: both attach before
+            # either wrote the manifest, both mint a trace. Re-read the
+            # disk here and adopt a winner's trace so the concurrent
+            # manifest writes stay identical and the job converges on
+            # ONE trace_id (a loser's pre-adoption claim events keep
+            # its minted id — the residual window is one read+write)
+            try:
+                with open(os.path.join(self.path, _MANIFEST)) as f:
+                    prev = json.load(f)
+                if prev.get("trace_id"):
+                    self._trace = TraceContext(
+                        prev["trace_id"],
+                        prev.get("trace_span_id") or "0" * 16,
+                    )
+            except (OSError, ValueError):
+                pass
+        if self._trace is not None:
+            # NOT part of the fingerprint: a resume with the same job
+            # shape must validate regardless of which trace started it
+            self._manifest["trace_id"] = self._trace.trace_id
+            self._manifest["trace_span_id"] = self._trace.span_id
         if self.path is not None:
             self._journal_write(
                 lambda: _atomic_write(
@@ -497,15 +553,23 @@ class BlockLedger:
         from ..utils import chaos as _chaos
 
         self._check_writer()
+        tid = self._trace.trace_id if self._trace is not None else None
+        sid: Optional[str] = None
         try:
-            with _span("jobs.block", job=self.job_id, block=i):
+            with _use_trace(self._trace), _span(
+                "jobs.block", job=self.job_id, block=i
+            ) as sp:
+                if sp is not None:
+                    tid, sid = sp.trace_id, sp.span_id
                 _chaos.site("jobs.block")
                 res = compute()
         except Exception as e:
+            self._block_trace[i] = (tid, sid)
             if is_transient(e) or is_oom(e):
                 raise
             self._record_quarantine(i, e, rows)
             return None
+        self._block_trace[i] = (tid, sid)
         self._record_done(i, res, rows)
         return res
 
@@ -540,6 +604,21 @@ class BlockLedger:
         block tmp files into one ``blocks/`` directory must never share
         a tmp path (the final rename target is the same by design)."""
         return ""
+
+    def _trace_fields(self, i: int) -> Dict[str, Any]:
+        """Trace identity for block ``i``'s ledger record: the
+        ``jobs.block`` span's ids when one was live, else the job-level
+        trace_id alone — ``ledger.jsonl`` plus the JSONL span sink must
+        reconstruct the block's story with no in-memory state."""
+        tid, sid = self._block_trace.get(i, (None, None))
+        if tid is None and self._trace is not None:
+            tid = self._trace.trace_id
+        out: Dict[str, Any] = {}
+        if tid:
+            out["trace_id"] = tid
+        if sid:
+            out["span_id"] = sid
+        return out
 
     def _journal_write(self, fn: Callable[[], None], what: str) -> None:
         """All journal mutations funnel through here: the chaos site
@@ -675,6 +754,7 @@ class BlockLedger:
             # believes it owns the block); the fence re-validates it at
             # actual write time, inside the writer thread
             tag = self._writer_tag(i)
+            tag.update(self._trace_fields(i))
 
             def write():
                 self._fence_check(i)
@@ -722,6 +802,28 @@ class BlockLedger:
         self._quar[i] = qb
         _m_blocks.inc(status="quarantined")
         _m_quarantined.inc()
+        _flight.record(
+            "jobs", "quarantine", job=self.job_id, block=i,
+            error=f"{qb.error_type}: {first_line(qb.error)}",
+        )
+        # the black box for the poison block: ring contents, metrics,
+        # config, chaos spec — linked from quarantine.json so the
+        # post-mortem starts from load_quarantine() alone
+        qb.debug_bundle = _flight.dump_bundle(
+            "block_quarantine",
+            # per-block debounce identity: sibling blocks poisoned
+            # milliseconds apart each get their linked bundle
+            debounce_key=f"{self.job_id}/{i}",
+            extra={
+                "job_id": self.job_id,
+                "op": self.op,
+                "block": i,
+                "rows": rows,
+                "error_type": qb.error_type,
+                "error": qb.error[:2000],
+                **self._trace_fields(i),
+            },
+        ) or ""
         logger.error(
             "job %s: block %d failed deterministically (%s: %s); "
             "quarantined — the job continues without it",
@@ -730,6 +832,7 @@ class BlockLedger:
         )
         if self.path is not None:
             tag = self._writer_tag(i)
+            tag.update(self._trace_fields(i))
 
             def write():
                 self._fence_check(i)
@@ -1004,10 +1107,18 @@ def _drive(
     constants,
     resumed: bool,
 ) -> JobResult:
+    # the job's trace identity: adopted from the journal on resume (the
+    # manifest carries it), inherited from the caller's ambient trace on
+    # a fresh job, minted otherwise — the manifest is stamped either
+    # way, so every later worker/resume continues ONE trace
+    if ledger._trace is None:
+        ledger._trace = _current_trace() or _new_trace()
     _register_start(ledger, resumed)
     ok = False
     try:
-        with _span("jobs.run", job=ledger.job_id, op=ledger.op):
+        with _use_trace(ledger._trace), _span(
+            "jobs.run", job=ledger.job_id, op=ledger.op, resumed=resumed
+        ):
             completed = _execute(
                 ledger.op, fetches, data, ledger, trim, feed_dict, constants
             )

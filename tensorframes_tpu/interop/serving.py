@@ -64,7 +64,13 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
-from ..obs import span as _span
+from ..obs import (
+    TraceContext as _TraceContext,
+    flight as _flight,
+    new_trace as _new_trace,
+    span as _span,
+    use_trace as _use_trace,
+)
 from ..utils import chaos as _chaos
 from ..obs.metrics import (
     counter as _counter,
@@ -78,8 +84,8 @@ __all__ = ["ScoringServer", "remote_arrow_mapper", "remote_map_in_arrow"]
 
 _m_requests = _counter(
     "serving.requests_total",
-    "Connections served, by kind (score|metrics|healthz|generate|http) "
-    "and terminal status",
+    "Connections served, by kind "
+    "(score|metrics|healthz|statusz|generate|http) and terminal status",
     labels=("kind", "status"),
 )
 _m_bytes_in = _counter(
@@ -298,6 +304,7 @@ class ScoringServer:
     _ROUTES: Dict[str, Tuple[str, ...]] = {
         "/metrics": ("GET",),
         "/healthz": ("GET",),
+        "/statusz": ("GET",),
         "/generate": ("POST",),
     }
 
@@ -366,14 +373,17 @@ class ScoringServer:
         parts = line.split()
         verb = parts[0].upper() if parts else ""
         path = (parts[1] if len(parts) > 1 else "/").split("?", 1)[0]
-        clen = 0
+        headers: Dict[str, str] = {}
         for hline in head.split(b"\r\n")[1:]:
             name, _, val = hline.partition(b":")
-            if name.strip().lower() == b"content-length":
-                try:
-                    clen = int(val.strip())
-                except ValueError:
-                    pass
+            headers[name.strip().lower().decode("latin-1", "replace")] = (
+                val.strip().decode("latin-1", "replace")
+            )
+        clen = 0
+        try:
+            clen = int(headers.get("content-length", "0"))
+        except ValueError:
+            pass
         while len(body) < clen:
             chunk = conn.recv(4096)
             if not chunk:
@@ -388,7 +398,10 @@ class ScoringServer:
         if allowed is None:
             # an unknown path is the CLIENT's mistake: say so crisply
             # instead of falling through to an ambiguous catch-all
-            out = b"endpoints: GET /metrics, GET /healthz, POST /generate\n"
+            out = (
+                b"endpoints: GET /metrics, GET /healthz, GET /statusz, "
+                b"POST /generate\n"
+            )
             status = "404 Not Found"
         elif verb not in allowed:
             # right path, wrong verb: 405 with the verbs that would work
@@ -406,9 +419,15 @@ class ScoringServer:
             kind = "healthz"
             status, out, extra_headers = self._handle_healthz()
             ctype = "application/json; charset=utf-8"
+        elif norm == "/statusz":
+            kind = "statusz"
+            status, out, extra_headers = self._handle_statusz()
+            ctype = "application/json; charset=utf-8"
         else:  # /generate, POST
             kind = "generate"
-            status, out, extra_headers = self._handle_generate(body)
+            status, out, extra_headers = self._handle_generate(
+                body, headers
+            )
             ctype = "application/json; charset=utf-8"
         header_lines = "".join(
             f"{k}: {v}\r\n" for k, v in extra_headers.items()
@@ -454,6 +473,12 @@ class ScoringServer:
             report["jobs"] = jobs_status()
         except Exception:  # health must never 500 over a status probe
             report["jobs"] = None
+        try:
+            # the flight recorder's recent debug bundles: the probe that
+            # notices a failure points straight at its black box
+            report["debug_bundles"] = _flight.recent_bundles()
+        except Exception:
+            report["debug_bundles"] = []
         body = json.dumps(report).encode("utf-8")
         if report["healthy"]:
             return "200 OK", body, {}
@@ -461,8 +486,63 @@ class ScoringServer:
             "Retry-After": _adaptive_retry_after(self._engine)
         }
 
+    def _handle_statusz(self) -> Tuple[str, bytes, Dict[str, str]]:
+        """``GET /statusz`` — the operator's at-a-glance page, JSON:
+
+        - ``requests``: the flight recorder's recent generate/score
+          records, newest last (kind, HTTP status, wall seconds,
+          trace_id — paste the trace_id into a grep over the JSONL sink
+          to pull the whole span tree);
+        - ``slowest_requests``: the same records, slowest first (top
+          10) — where to start when p99 moved;
+        - ``debug_bundles``: recent flight-recorder bundles (path,
+          reason, timestamp), newest first;
+        - ``flight``: events currently held per ring;
+        - ``chaos``: the active chaos spec ("" when clean — anything
+          else taints every number on the page);
+        - ``trace_sink``: whether a JSONL span sink is attached.
+
+        Always 200; rendering never touches the engine (a wedged engine
+        must not take the status page down with it)."""
+        import json
+
+        from ..obs import trace_sink as _trace_sink
+        from ..utils import chaos as _chaos_mod
+
+        rings = _flight.rings()
+        requests = rings.get("serving", [])
+        slowest = sorted(
+            requests, key=lambda e: e.get("dur_s") or 0.0, reverse=True
+        )[:10]
+        payload = {
+            "requests": requests[-50:],
+            "slowest_requests": slowest,
+            "debug_bundles": _flight.recent_bundles(),
+            "flight": {name: len(evts) for name, evts in rings.items()},
+            "chaos": _chaos_mod.active_spec(),
+            "trace_sink": _trace_sink() is not None,
+        }
+        return "200 OK", json.dumps(payload, default=str).encode(
+            "utf-8"
+        ), {}
+
+    @staticmethod
+    def _timing_payload(handle, total_s: float) -> Dict[str, Any]:
+        """The per-request timing breakdown echoed in the generate
+        response: endpoint wall clock plus whatever stages the engine
+        recorded on the handle (queue wait, prefill, chunked-prefill
+        dispatches, summed decode gaps, fleet replays)."""
+        t = dict(handle.timings) if handle is not None else {}
+        out: Dict[str, Any] = {"total_s": round(total_s, 6)}
+        for k in ("queue_wait_s", "prefill_s", "decode_s"):
+            if k in t:
+                out[k] = round(float(t[k]), 6)
+        out["prefill_chunks"] = int(t.get("prefill_chunks", 0))
+        out["replays"] = int(t.get("replays", 0))
+        return out
+
     def _handle_generate(
-        self, body: bytes
+        self, body: bytes, headers: Optional[Dict[str, str]] = None
     ) -> Tuple[str, bytes, Dict[str, str]]:
         """One generate request against the engine; returns (status,
         JSON body, extra headers). Failure modes map to HTTP semantics
@@ -470,13 +550,50 @@ class ScoringServer:
         request → 400, no engine → 501, full admission queue or
         unhealthy engine → fast 503 with ``Retry-After`` (shedding, not
         blocking), missed deadline (``"deadline_s"`` in the request, or
-        the ``serve_result_timeout_s`` backstop) → 504."""
+        the ``serve_result_timeout_s`` backstop) → 504.
+
+        **Tracing**: a W3C ``traceparent`` request header is adopted
+        (same trace_id, this server as a child position) — absent or
+        malformed, a fresh trace starts. Every response carries a
+        ``traceparent`` header and a ``"trace_id"`` JSON field, and
+        completed generations add a ``"timing"`` breakdown (queue wait,
+        prefill, chunked-prefill count, decode, replay count), so a
+        caller can join its own telemetry to the engine's spans in the
+        JSONL sink (docs/observability.md)."""
         import json
 
+        t0 = time.perf_counter()
+        root = _TraceContext.from_traceparent(
+            (headers or {}).get("traceparent")
+        )
+        ctx = root.child() if root is not None else _new_trace()
+
+        def reply(
+            status: str,
+            payload: Dict[str, Any],
+            extra: Optional[Dict[str, str]] = None,
+            handle=None,
+        ) -> Tuple[str, bytes, Dict[str, str]]:
+            total = time.perf_counter() - t0
+            payload["trace_id"] = ctx.trace_id
+            if handle is not None or status.startswith("200"):
+                payload["timing"] = self._timing_payload(handle, total)
+            _flight.record(
+                "serving", "generate",
+                status=status.split(" ", 1)[0],
+                trace_id=ctx.trace_id,
+                dur_s=round(total, 6),
+                request_id=payload.get("request_id"),
+            )
+            hdrs = dict(extra or {})
+            hdrs["traceparent"] = ctx.traceparent()
+            return status, json.dumps(payload).encode("utf-8"), hdrs
+
         if self._engine is None:
-            return "501 Not Implemented", json.dumps(
-                {"error": "server has no generation engine"}
-            ).encode("utf-8"), {}
+            return reply(
+                "501 Not Implemented",
+                {"error": "server has no generation engine"},
+            )
         from ..serve.engine import EngineUnhealthyError
         from ..serve.scheduler import QueueFullError
         from ..utils.config import get_config
@@ -499,38 +616,44 @@ class ScoringServer:
                 # from submit instead would blame the client for any
                 # internal TypeError bug)
                 if not hasattr(self._engine, "replica_names"):
-                    return "400 Bad Request", json.dumps(
+                    return reply(
+                        "400 Bad Request",
                         {"error": "session affinity requires a fleet "
-                                  "engine (serve.Fleet)"}
-                    ).encode("utf-8"), {}
+                                  "engine (serve.Fleet)"},
+                    )
                 kwargs["session"] = str(spec["session"])
         except (ValueError, KeyError, TypeError) as e:
-            return "400 Bad Request", json.dumps(
-                {"error": f"bad request: {type(e).__name__}: {e}"}
-            ).encode("utf-8"), {}
+            return reply(
+                "400 Bad Request",
+                {"error": f"bad request: {type(e).__name__}: {e}"},
+            )
         try:
-            handle = self._engine.submit(prompt, max_new, **kwargs)
+            # the ambient trace around submit is how the trace_id
+            # reaches the engine/fleet: the request record and every
+            # engine-side span (prefill, chunks, failover replays) join
+            # this request's trace
+            with _use_trace(ctx), _span(
+                "serving.generate", prompt_len=len(prompt),
+                max_new=max_new,
+            ):
+                handle = self._engine.submit(prompt, max_new, **kwargs)
         except TimeoutError as e:
             # the fleet router can notice a deadline expiring DURING
             # placement (DeadlineExceededError) — same 504 as a stream
             # that expired mid-generation
-            return "504 Gateway Timeout", json.dumps(
-                {"error": str(e)}
-            ).encode("utf-8"), {}
+            return reply("504 Gateway Timeout", {"error": str(e)})
         except (QueueFullError, EngineUnhealthyError) as e:
             # overload shedding: the caller can retry, THIS server can't
             # help right now — answer fast instead of parking the
             # connection against a full queue or a dead engine. The
             # Retry-After adapts to the backlog (depth x p50 ITL).
-            return "503 Service Unavailable", json.dumps(
-                {"error": str(e)}
-            ).encode("utf-8"), {
-                "Retry-After": _adaptive_retry_after(self._engine)
-            }
+            return reply(
+                "503 Service Unavailable",
+                {"error": str(e)},
+                {"Retry-After": _adaptive_retry_after(self._engine)},
+            )
         except ValueError as e:
-            return "400 Bad Request", json.dumps(
-                {"error": str(e)}
-            ).encode("utf-8"), {}
+            return reply("400 Bad Request", {"error": str(e)})
         try:
             toks = handle.result(
                 timeout=get_config().serve_result_timeout_s
@@ -538,22 +661,28 @@ class ScoringServer:
         except TimeoutError as e:
             # DeadlineExceededError (the scheduler evicted it) and the
             # result-timeout backstop both mean the same thing upstream
-            return "504 Gateway Timeout", json.dumps(
-                {"request_id": handle.request_id, "error": str(e)}
-            ).encode("utf-8"), {}
+            return reply(
+                "504 Gateway Timeout",
+                {"request_id": handle.request_id, "error": str(e)},
+                handle=handle,
+            )
         except Exception as e:  # engine-side failure closed the handle
-            return "500 Internal Server Error", json.dumps(
+            return reply(
+                "500 Internal Server Error",
                 {
                     "request_id": handle.request_id,
                     "error": f"{type(e).__name__}: {e}",
-                }
-            ).encode("utf-8"), {}
-        return "200 OK", json.dumps(
+                },
+                handle=handle,
+            )
+        return reply(
+            "200 OK",
             {
                 "request_id": handle.request_id,
                 "tokens": [int(t) for t in toks],
-            }
-        ).encode("utf-8"), {}
+            },
+            handle=handle,
+        )
 
     def _serve_one(self, conn: socket.socket) -> None:
         import pyarrow as pa
@@ -668,6 +797,22 @@ class ScoringServer:
             _m_requests.inc(kind=kind, status=status)
             if kind == "score" and status != "empty":
                 _m_latency.observe(time.perf_counter() - t0)
+            if kind not in ("generate", "empty") and status != "empty":
+                # generate requests record themselves (with trace ids)
+                # inside the handler; real work (score/http) lands in
+                # the same `serving` ring, while metrics/health/statusz
+                # PROBES get their own — a 15s scrape + health check
+                # would otherwise evict the entire trace-id request
+                # history from the 512-slot ring within the hour
+                ring = (
+                    "probes"
+                    if kind in ("metrics", "healthz", "statusz")
+                    else "serving"
+                )
+                _flight.record(
+                    ring, kind, status=status,
+                    dur_s=round(time.perf_counter() - t0, 6),
+                )
             self._limit.release()
 
 

@@ -29,12 +29,18 @@ from .metrics import (
 )
 from .tracing import (
     Span,
+    TraceContext,
     current_span,
+    current_trace,
+    event,
+    new_trace,
     set_annotations,
     set_trace_sink,
     span,
     trace_sink,
+    use_trace,
 )
+from . import flight
 
 __all__ = [
     "Counter",
@@ -50,9 +56,15 @@ __all__ = [
     "render_prometheus",
     "enabled",
     "Span",
+    "TraceContext",
     "span",
+    "event",
     "current_span",
+    "current_trace",
+    "new_trace",
+    "use_trace",
     "set_annotations",
     "set_trace_sink",
     "trace_sink",
+    "flight",
 ]

@@ -1,0 +1,301 @@
+"""Crash flight recorder: bounded in-memory event rings + debug bundles.
+
+Counters say *how often*; spans say *how long*; neither says *what the
+last 500 interesting things were* when an engine dies at 3am. The flight
+recorder is that third signal: a set of bounded per-subsystem ring
+buffers (``collections.deque`` with ``maxlen`` — append is O(1), ~2 µs
+per event, memory strictly bounded) fed by the failure-adjacent paths:
+
+- ``trace``   — completed spans / point events (only while
+  :func:`capture_spans` is on — span capture makes every ``span()``
+  live, which costs ~2-3 µs each on dispatch paths, so it is a consumer
+  you attach deliberately, exactly like the JSONL sink);
+- ``chaos``   — every injected fault (``utils/chaos.py``);
+- ``retries`` — transient-failure retries and exhaustions
+  (``utils/failures.py``);
+- ``preemptions`` — preempt-and-requeue evictions;
+- ``fences``  — distributed-job write-fence rejects
+  (``engine/dist_jobs.py``);
+- ``serve`` / ``fleet`` / ``jobs`` / ``serving`` — subsystem lifecycle
+  events (engine fatal/restart, replica fence/replay, block quarantine,
+  request completions).
+
+On a terminal event — engine fatal step, ``restart()``, block
+quarantine, write-fence reject — :func:`dump_bundle` snapshots the whole
+story to ONE JSON file (a **debug bundle**): every ring's contents, the
+full metrics snapshot, the caller's health report, the resolved
+``Config``, and the active chaos spec. Bundles are listed by
+``GET /statusz``, linked from ``quarantine.json``, and surfaced in
+``GET /healthz`` (``interop/serving.py``), so the artifact that explains
+a failure is one click from the probe that noticed it. Layout and the
+operator cookbook: ``docs/observability.md``.
+
+Kill-switch parity: ``TFT_OBS=0`` / ``Config(observability=False)``
+makes :func:`record` a no-op (one predicate — the same gate as the
+metrics registry) and :func:`dump_bundle` return ``None``. Nothing here
+ever runs inside a traced/compiled function, so the recorder adds zero
+compiled programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+from .metrics import counter as _counter, enabled, snapshot
+
+__all__ = [
+    "capture_spans",
+    "dump_bundle",
+    "last_bundle",
+    "record",
+    "record_span",
+    "recent_bundles",
+    "reset",
+    "rings",
+    "span_capture_on",
+]
+
+logger = get_logger("obs.flight")
+
+_m_bundles = _counter(
+    "obs.debug_bundles_total",
+    "Debug bundles dumped by the flight recorder, by trigger reason",
+    labels=("reason",),
+)
+
+#: events kept per subsystem ring (each event is a small tuple; 512
+#: events ≈ tens of KB per subsystem). A malformed or non-positive
+#: TFT_FLIGHT_EVENTS falls back — a typo'd knob must not crash
+#: `import tensorframes_tpu` (this module loads with the package).
+def _env_ring_len() -> int:
+    try:
+        n = int(os.environ.get("TFT_FLIGHT_EVENTS", "512") or 512)
+    except ValueError:
+        return 512
+    return n if n > 0 else 512
+
+
+_RING_LEN = _env_ring_len()
+
+_rings_lock = threading.Lock()
+_rings: Dict[str, Deque[Tuple[float, str, Dict[str, Any]]]] = {}
+
+#: recent bundle registry for /statusz and /healthz
+_bundles: Deque[Dict[str, Any]] = deque(maxlen=16)
+#: (reason, dir) -> last dump monotonic time; a crash LOOP must not
+#: write hundreds of identical bundles per second
+_last_dump: Dict[Tuple[str, str], float] = {}
+_DUMP_DEBOUNCE_S = 1.0
+
+
+def _ring(subsystem: str) -> Deque[Tuple[float, str, Dict[str, Any]]]:
+    ring = _rings.get(subsystem)
+    if ring is None:
+        with _rings_lock:
+            ring = _rings.setdefault(subsystem, deque(maxlen=_RING_LEN))
+    return ring
+
+
+def record(subsystem: str, kind: str, **data) -> None:
+    """Append one event to ``subsystem``'s ring. ~2 µs: one gate check,
+    one ``time.time()``, one bounded-deque append (appends on a deque
+    are thread-safe under the GIL; the ring needs no lock of its own).
+    No-op when observability is off."""
+    if not enabled():
+        return
+    _ring(subsystem).append((time.time(), kind, data))
+
+
+def record_span(
+    name: str,
+    trace_id: Optional[str],
+    span_id: str,
+    dur_s: float,
+    attrs: Dict[str, Any],
+) -> None:
+    """The tracing layer's feed (``obs/tracing.py:_emit``): one closed
+    span or point event into the ``trace`` ring."""
+    if not enabled():
+        return
+    _ring("trace").append(
+        (
+            time.time(),
+            "span",
+            {
+                "name": name,
+                "trace_id": trace_id,
+                "span_id": span_id,
+                "dur_s": dur_s,
+                "attrs": attrs,
+            },
+        )
+    )
+
+
+_capture_spans = False
+
+
+def capture_spans(on: bool) -> None:
+    """Make every ``span()`` live and mirror it into the ``trace`` ring
+    (a span CONSUMER, like the JSONL sink — span creation then costs
+    ~2-3 µs each on the dispatch paths it instruments). The bundle's
+    ``trace`` ring is empty unless this (or a sink with spans feeding
+    other rings) is on."""
+    global _capture_spans
+    _capture_spans = bool(on)
+    from . import tracing as _tracing
+
+    _tracing._set_flight_capture(_capture_spans)
+
+
+def span_capture_on() -> bool:
+    return _capture_spans
+
+
+def rings() -> Dict[str, List[Dict[str, Any]]]:
+    """Every ring's contents as JSON-ready dicts, oldest first."""
+    with _rings_lock:
+        names = list(_rings)
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for name in names:
+        out[name] = [
+            {"ts": ts, "kind": kind, **_jsonable(data)}
+            for ts, kind, data in list(_rings[name])
+        ]
+    return out
+
+
+def _jsonable(data: Dict[str, Any]) -> Dict[str, Any]:
+    try:
+        json.dumps(data)
+        return data
+    except (TypeError, ValueError):
+        return {k: str(v) for k, v in data.items()}
+
+
+def reset() -> None:
+    """Drop every ring and the bundle registry (test isolation)."""
+    with _rings_lock:
+        _rings.clear()
+    _bundles.clear()
+    _last_dump.clear()
+
+
+def recent_bundles() -> List[Dict[str, Any]]:
+    """The last bundles dumped by this process, newest first:
+    ``[{"ts_unix", "reason", "path"}, ...]`` — what ``/statusz`` and
+    ``/healthz`` surface."""
+    return list(reversed(_bundles))
+
+
+def last_bundle() -> Optional[Dict[str, Any]]:
+    return _bundles[-1] if _bundles else None
+
+
+def _bundle_dir(explicit: Optional[str]) -> str:
+    if explicit:
+        return explicit
+    from ..utils.config import get_config
+
+    cfg_dir = get_config().debug_bundle_dir
+    if cfg_dir:
+        return cfg_dir
+    return os.environ.get("TFT_DEBUG_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "tensorframes_tpu", "debug"
+    )
+
+
+def dump_bundle(
+    reason: str,
+    *,
+    health: Optional[Dict[str, Any]] = None,
+    extra: Optional[Dict[str, Any]] = None,
+    dir: Optional[str] = None,
+    debounce_key: Optional[str] = None,
+) -> Optional[str]:
+    """Write one debug bundle and return its path (``None`` when
+    observability is off, the same ``reason``+directory dumped within
+    the last second — a crash loop must not flood the disk — or the
+    write failed; a recorder that crashes the failure path it documents
+    would be worse than no recorder). ``debounce_key`` widens the
+    debounce identity: DISTINCT failures of one reason in quick
+    succession (e.g. several blocks quarantining milliseconds apart)
+    each deserve their bundle — pass the failing unit's id so only true
+    repeats are suppressed.
+
+    The bundle is a single JSON file::
+
+        {"reason": ..., "ts_unix": ..., "host": ..., "pid": ...,
+         "rings": {subsystem: [events...]},   # the flight recorder
+         "metrics": {...},                    # obs.snapshot()
+         "health": {...},                     # caller's health() report
+         "config": {...},                     # resolved Config
+         "chaos_spec": "...",                 # active chaos schedule
+         "extra": {...}}                      # trigger-specific context
+
+    Directory precedence: ``dir`` argument, ``Config.debug_bundle_dir``,
+    ``TFT_DEBUG_DIR``, ``~/.cache/tensorframes_tpu/debug``."""
+    if not enabled():
+        return None
+    try:
+        root = _bundle_dir(dir)
+        key = (
+            reason if debounce_key is None
+            else f"{reason}:{debounce_key}",
+            root,
+        )
+        now = time.monotonic()
+        last = _last_dump.get(key)
+        if last is not None and now - last < _DUMP_DEBOUNCE_S:
+            return None
+        _last_dump[key] = now
+        os.makedirs(root, exist_ok=True)
+        from ..utils import chaos as _chaos
+        from ..utils.config import get_config
+
+        ts = time.time()
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime(ts))
+        safe_reason = "".join(
+            c if c.isalnum() or c in "-_" else "-" for c in reason
+        )
+        path = os.path.join(
+            root,
+            f"bundle-{stamp}-{safe_reason}-{os.getpid()}-{int(ts * 1e3) % 100000}.json",
+        )
+        bundle = {
+            "version": 1,
+            "reason": reason,
+            "ts_unix": ts,
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "rings": rings(),
+            "metrics": snapshot(),
+            "health": health,
+            "config": dataclasses.asdict(get_config()),
+            "chaos_spec": _chaos.active_spec(),
+            "extra": extra or {},
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, indent=1, default=str)
+        os.replace(tmp, path)
+        _bundles.append(
+            {"ts_unix": ts, "reason": reason, "path": path}
+        )
+        _m_bundles.inc(reason=reason)
+        logger.warning("flight recorder: debug bundle dumped: %s", path)
+        return path
+    except Exception:
+        logger.warning(
+            "flight recorder: bundle dump for %r failed", reason,
+            exc_info=True,
+        )
+        return None

@@ -312,7 +312,19 @@ def _prom_name(name: str) -> str:
 
 
 def _prom_escape(v: str) -> str:
+    """Label-VALUE escaping per the exposition format (0.0.4): backslash
+    first (so the escapes it introduces survive), then double-quote,
+    then newline. A label value carrying exception text — the `status`
+    reasons on failure counters do — must round-trip a scrape parse."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_escape_help(v: str) -> str:
+    """HELP-text escaping: the exposition format escapes backslash and
+    newline there (quotes stay literal — HELP text is not quoted). Help
+    strings are author-controlled, but one embedded newline would split
+    the line and corrupt every series after it in the scrape."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _prom_labels(names: Tuple[str, ...], key: Tuple[str, ...], extra="") -> str:
@@ -401,7 +413,7 @@ class MetricsRegistry:
             m = self._metrics[name]
             pname = _prom_name(name)
             if m.help:
-                lines.append(f"# HELP {pname} {m.help}")
+                lines.append(f"# HELP {pname} {_prom_escape_help(m.help)}")
             lines.append(f"# TYPE {pname} {m.kind}")
             series = m._series()
             if isinstance(m, Histogram):

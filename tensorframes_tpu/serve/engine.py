@@ -67,7 +67,12 @@ from ..models.transformer import (
     transformer_prefill_chunk,
     transformer_step,
 )
-from ..obs import span as _span
+from ..obs import (
+    current_trace as _current_trace,
+    flight as _flight,
+    span as _span,
+    use_trace as _use_trace,
+)
 from ..obs.metrics import (
     counter as _counter,
     gauge as _gauge,
@@ -77,6 +82,7 @@ from ..utils import chaos as _chaos
 from ..utils.config import get_config
 from ..utils.failures import (
     DeadlineExceededError,
+    first_line as _first_line,
     is_oom,
     is_transient,
     run_with_retries,
@@ -534,6 +540,7 @@ class GenerationEngine:
         block: bool = True,
         timeout: Optional[float] = None,
         deadline: Optional[float] = None,
+        trace=None,
         _handle_factory=None,
     ) -> GenerationHandle:
         """Queue one generation request; returns its streaming handle.
@@ -545,6 +552,12 @@ class GenerationEngine:
         now: the step sweep evicts the request — queued or
         mid-generation — once it passes, and the handle raises
         :class:`~tensorframes_tpu.utils.failures.DeadlineExceededError`.
+
+        ``trace`` attaches a
+        :class:`~tensorframes_tpu.obs.TraceContext` the request's
+        engine-side spans join (default: the submitting thread's
+        current trace, so an HTTP ``traceparent`` flows through without
+        every caller threading it explicitly).
 
         ``_handle_factory`` (private) lets the fleet router
         (``serve/fleet.py``) substitute its relay handle —
@@ -591,6 +604,7 @@ class GenerationEngine:
             deadline_t=(
                 None if deadline is None else time.monotonic() + deadline
             ),
+            trace=trace if trace is not None else _current_trace(),
         )
         try:
             self.scheduler.submit(req, block=block, timeout=timeout)
@@ -784,6 +798,11 @@ class GenerationEngine:
         exceeds the chunk size or a prefix-cache hit starts mid-prompt."""
         req = act.req
         plen = len(req.prompt)
+        timings = req.handle.timings
+        if "queue_wait_s" not in timings:
+            # first admission only (preemption/replay requeues keep the
+            # original submitted_at, and setdefault keeps the first wait)
+            timings["queue_wait_s"] = time.monotonic() - req.submitted_at
         if self.prefix_cache is not None:
             _m_prefix_lookups.inc()
             if act.cached_tokens > 0:
@@ -859,7 +878,8 @@ class GenerationEngine:
                 )
             )
 
-        with _span(
+        t0 = time.perf_counter()
+        with _use_trace(req.trace), _span(
             "serve.prefill_chunk",
             request=req.request_id,
             start=start,
@@ -869,6 +889,11 @@ class GenerationEngine:
                 dispatch,
                 what=f"serve.prefill_chunk request {req.request_id}",
             )
+        timings = req.handle.timings
+        timings["prefill_s"] = (
+            timings.get("prefill_s", 0.0) + time.perf_counter() - t0
+        )
+        timings["prefill_chunks"] = timings.get("prefill_chunks", 0) + 1
         act.prefill_pos = start + valid
         _m_prefill_chunks.inc()
         if act.prefill_pos >= plen:
@@ -907,10 +932,17 @@ class GenerationEngine:
                 self._prefill_jit(self._params_dev, pool.k, pool.v, *args)
             )
 
-        with _span("serve.prefill", request=req.request_id, prompt_len=plen):
+        t0 = time.perf_counter()
+        with _use_trace(req.trace), _span(
+            "serve.prefill", request=req.request_id, prompt_len=plen
+        ):
             pool.k, pool.v, tok = run_with_retries(
                 dispatch, what=f"serve.prefill request {req.request_id}"
             )
+        timings = req.handle.timings
+        timings["prefill_s"] = (
+            timings.get("prefill_s", 0.0) + time.perf_counter() - t0
+        )
         act.prefill_pos = plen
         self._register_prefix(act)
         self._emit(idx, act, int(tok))
@@ -964,6 +996,9 @@ class GenerationEngine:
             _m_ttft.observe(now - act.req.submitted_at)
         elif act.last_emit_t is not None:
             _m_itl.observe(now - act.last_emit_t)
+        if act.last_emit_t is not None:
+            t = act.req.handle.timings
+            t["decode_s"] = t.get("decode_s", 0.0) + now - act.last_emit_t
         act.last_emit_t = now
         eos = act.req.eos_id
         if (eos is not None and tok == eos) or act.remaining <= 0:
@@ -1025,6 +1060,22 @@ class GenerationEngine:
             _m_requests.inc(n, status="failed")
             _m_handles_failed.inc(n, reason=reason)
         self._refresh_gauges()
+        # the flight recorder's moment: every consumer has its error, so
+        # snapshotting here cannot delay anyone — dump the black box
+        _flight.record(
+            "serve", "engine_fatal", reason=reason,
+            error=f"{type(error).__name__}: {_first_line(error)}",
+            handles_failed=n,
+        )
+        _flight.dump_bundle(
+            "engine_fatal",
+            health=self.health(),
+            extra={
+                "error_type": type(error).__name__,
+                "error": str(error)[:2000],
+                "handles_failed": n,
+            },
+        )
 
     def restart(self) -> "GenerationEngine":
         """Rebuild device state from host-side scheduler progress after a
@@ -1062,6 +1113,15 @@ class GenerationEngine:
             self.healthy = True
             self._last_step_t = time.monotonic()
         _m_restarts.inc()
+        _flight.record(
+            "serve", "engine_restart",
+            requeued=self.scheduler.queue_depth,
+        )
+        _flight.dump_bundle(
+            "engine_restart",
+            health=self.health(),
+            extra={"requeued": self.scheduler.queue_depth},
+        )
         with self.scheduler._lock:
             self.scheduler._lock.notify_all()  # wake the stepping thread
         logger.warning(

@@ -63,10 +63,20 @@ from typing import Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import (
+    current_trace as _current_trace,
+    event as _trace_event,
+    flight as _flight,
+    use_trace as _use_trace,
+)
 from ..obs.metrics import counter as _counter, gauge as _gauge
 from ..utils import chaos as _chaos
 from ..utils.config import get_config
-from ..utils.failures import DeadlineExceededError, run_with_retries
+from ..utils.failures import (
+    DeadlineExceededError,
+    first_line as _first_line,
+    run_with_retries,
+)
 from ..utils.logging import get_logger
 from .engine import EngineUnhealthyError, GenerationEngine
 from .scheduler import GenerationHandle, QueueFullError
@@ -135,7 +145,7 @@ class _FleetRequest:
     __slots__ = (
         "request_id", "prompt", "max_new_tokens", "temperature", "top_p",
         "seed", "eos_id", "deadline_t", "session", "handle", "replica",
-        "inner", "replays", "last_error", "lock", "parked_t",
+        "inner", "replays", "last_error", "lock", "parked_t", "trace",
     )
 
     def __init__(
@@ -174,6 +184,10 @@ class _FleetRequest:
         #: each death); bounds how long a survivor may wait for a
         #: healthy replica before failing fail-fast-style
         self.parked_t: Optional[float] = None
+        #: the request's TraceContext: one trace_id across EVERY replica
+        #: that serves it — each replay adds a ``fleet.replay`` event
+        #: with a ``replay=N`` attribute to the same trace
+        self.trace = None
 
 
 class _RelayHandle(GenerationHandle):
@@ -189,6 +203,10 @@ class _RelayHandle(GenerationHandle):
         super().__init__(request_id)
         self._fleet = fleet
         self._rec = rec
+        # the engine writes its timing breakdown to the handle IT holds
+        # (this relay); sharing the dict object makes those writes land
+        # on the caller's FleetHandle — and accumulate across replays
+        self.timings = rec.handle.timings
         with rec.lock:
             rec.inner = self
 
@@ -450,6 +468,7 @@ class Fleet:
             eos_id=rec.eos_id,
             block=False,
             deadline=deadline,
+            trace=rec.trace,
             _handle_factory=lambda rid: _RelayHandle(rid, self, rec),
         )
         rec.replica = rep
@@ -506,6 +525,11 @@ class Fleet:
             session,
             FleetHandle(rid),
         )
+        # one trace_id for the request's whole life, however many
+        # replicas serve it (the HTTP handler installs the traceparent's
+        # context around this call; a fresh submit inherits any ambient
+        # trace the same way)
+        rec.trace = _current_trace()
         t_end = None if timeout is None else time.monotonic() + timeout
         while True:
             cands = run_with_retries(
@@ -694,6 +718,23 @@ class Fleet:
                 return True
             rec.replays += 1
             _m_replays.inc()
+            rec.handle.timings["replays"] = rec.replays
+            # a new span in the SAME trace marks the failover point: the
+            # replayed request's prefill/decode spans on the new replica
+            # carry the same trace_id, so the whole story is one trace
+            with _use_trace(rec.trace):
+                _trace_event(
+                    "fleet.replay",
+                    request=rec.request_id,
+                    replica=rep.name,
+                    replay=rec.replays,
+                    emitted=len(rec.handle._tokens),
+                    error=type(rec.last_error).__name__,
+                )
+            _flight.record(
+                "fleet", "replay", request=rec.request_id,
+                replica=rep.name, replay=rec.replays,
+            )
             logger.warning(
                 "fleet: request %d replayed on replica %s after %s "
                 "(%d emitted token(s) folded into the prompt)",
@@ -780,6 +821,10 @@ class Fleet:
             rep.state = "fenced"
             rep.wedged = wedged
         _m_failovers.inc()
+        _flight.record(
+            "fleet", "fence", replica=rep.name, wedged=wedged,
+            error=f"{type(error).__name__}: {_first_line(error)}",
+        )
         logger.warning(
             "fleet: replica %s fenced (%s: %s); draining%s",
             rep.name,
@@ -902,6 +947,7 @@ class Fleet:
             with rep.lock:
                 rep.state = "active"
                 rep.wedged = False
+            _flight.record("fleet", "readmit", replica=rep.name)
             logger.warning(
                 "fleet: replica %s re-admitted (restart + probe ok)",
                 rep.name,

@@ -71,6 +71,15 @@ class GenerationHandle:
         self._tokens: List[int] = []
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
+        #: per-request timing breakdown, filled by the engine as the
+        #: stream progresses: ``queue_wait_s`` (submit -> first admit),
+        #: ``prefill_s`` (sum of prefill dispatch walls — replays and
+        #: recompute-style preemptions accumulate), ``prefill_chunks``
+        #: (chunked-prefill dispatches), ``decode_s`` (sum of
+        #: inter-emission gaps), ``replays`` (fleet failovers). The
+        #: serving endpoint echoes this dict in the HTTP response
+        #: (docs/observability.md).
+        self.timings: dict = {}
 
     # -- engine side -------------------------------------------------------
 
@@ -133,6 +142,12 @@ class GenRequest:
     #: the engine's step sweep evicts expired requests (queued OR
     #: mid-generation) with :class:`DeadlineExceededError`
     deadline_t: Optional[float] = None
+    #: the request's :class:`~tensorframes_tpu.obs.TraceContext` — the
+    #: engine's per-request spans (prefill, prefill chunks) join this
+    #: trace on the stepping thread, so one trace_id follows the request
+    #: from the HTTP ingress through placement, prefill, and any
+    #: failover replay (docs/observability.md)
+    trace: Optional[object] = None
 
 
 class _Active:
@@ -398,6 +413,7 @@ class Scheduler:
             submitted_at=req.submitted_at,
             emitted=req.emitted + len(act.generated),
             deadline_t=req.deadline_t,
+            trace=req.trace,
         )
         record_preemption("serve")
         self._requeue_front(new_req)

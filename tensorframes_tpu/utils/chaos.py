@@ -318,6 +318,9 @@ def _fire(name: str) -> None:
         todo = [r for r in _rules.get(name, ()) if r.should_fire(_rng)]
     for r in todo:
         _m_injections.inc(site=name, kind=r.kind)
+        from ..obs import flight as _flight
+
+        _flight.record("chaos", r.kind, site=name)
         logger.warning("chaos: injecting %s at %s", r.kind, name)
         if r.kind == "latency":
             time.sleep(r.latency_s)

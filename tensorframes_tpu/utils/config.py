@@ -131,6 +131,11 @@ class Config:
     #: means ``job_lease_ttl_s / 3`` — three chances to renew before
     #: expiry. Per-worker override: ``run_worker(heartbeat_s=)``.
     job_heartbeat_s: float = 0.0
+    #: directory for the flight recorder's debug bundles
+    #: (``obs/flight.py``: the JSON dumped on an engine fatal,
+    #: ``restart()``, block quarantine, or write-fence reject). Empty
+    #: means ``$TFT_DEBUG_DIR`` or ``~/.cache/tensorframes_tpu/debug``.
+    debug_bundle_dir: str = ""
     #: default quarantine policy for batch jobs: True returns partial
     #: results (``JobResult.completed`` + ``.quarantined``) when a block
     #: fails deterministically; False (strict) raises

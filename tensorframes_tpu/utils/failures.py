@@ -41,6 +41,7 @@ from .logging import get_logger
 __all__ = [
     "adopt_retry_deadline",
     "current_retry_deadline",
+    "first_line",
     "is_oom",
     "is_transient",
     "retry_deadline",
@@ -57,6 +58,7 @@ __all__ = [
 
 logger = get_logger("failures")
 
+from ..obs import flight as _flight  # noqa: E402
 from ..obs.metrics import counter as _counter  # noqa: E402
 
 #: one series per (op, failure reason): makes flaky-link behavior (the
@@ -91,12 +93,21 @@ def record_oom_split(op: str) -> None:
     _oom_splits_total.inc(op=op)
 
 
+def first_line(err: object, limit: int = 200) -> str:
+    """First line of ``str(err)``, bounded — the log/label/flight-ring
+    rendering of an exception. split, not splitlines: an exception
+    classified off its CAUSE chain can have an empty ``str(e)``, and
+    ``"".splitlines()`` is ``[]``."""
+    return str(err).split("\n", 1)[0][:limit]
+
+
 def record_preemption(op: str) -> None:
     """Count one preempt-and-requeue. Like :func:`record_oom_split`, the
     preemption itself happens at the resource owner (the serving
     scheduler evicting a sequence when its KV page pool runs dry); the
     counter lives here with the rest of the failure telemetry."""
     _preemptions_total.inc(op=op)
+    _flight.record("preemptions", "preempt", op=op)
 
 T = TypeVar("T")
 
@@ -394,6 +405,10 @@ def run_with_retries(
             ):
                 if is_transient(e):
                     _retries_exhausted_total.inc(op=_op_label(what))
+                    _flight.record(
+                        "retries", "exhausted", what=what,
+                        attempts=attempt + 1, error=first_line(e),
+                    )
                     if out_of_time:
                         logger.warning(
                             "%s: retry deadline reached after %d "
@@ -404,6 +419,14 @@ def run_with_retries(
             delay = _backoff_delay(attempt, cfg.retry_backoff_s)
             if deadline is not None and time.monotonic() + delay >= deadline:
                 _retries_exhausted_total.inc(op=_op_label(what))
+                # this exhaustion must reach the flight ring too — a
+                # bundle whose counters say "exhausted" but whose
+                # retries ring shows none contradicts itself
+                _flight.record(
+                    "retries", "exhausted", what=what,
+                    attempts=attempt + 1, reason="deadline",
+                    error=first_line(e),
+                )
                 logger.warning(
                     "%s: backoff of %.2fs would pass the retry deadline; "
                     "giving up after %d attempt(s)",
@@ -412,6 +435,10 @@ def run_with_retries(
                 raise
             attempt += 1
             _retries_total.inc(op=_op_label(what), reason=_failure_reason(e))
+            _flight.record(
+                "retries", "retry", what=what, attempt=attempt,
+                reason=_failure_reason(e), delay_s=round(delay, 4),
+            )
             # split, not splitlines: an exception classified off its CAUSE
             # chain can have an empty str(e), and "".splitlines() is []
             logger.warning(

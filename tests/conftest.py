@@ -31,6 +31,17 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _debug_bundles_in_tmp(tmp_path_factory):
+    """Flight-recorder debug bundles (engine fatals, quarantines the
+    fault suites deliberately trigger) land in the test session's tmp
+    dir, not the developer's ~/.cache. setdefault so an explicit
+    operator/CI TFT_DEBUG_DIR still wins."""
+    os.environ.setdefault(
+        "TFT_DEBUG_DIR", str(tmp_path_factory.mktemp("debug-bundles"))
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -18,6 +18,8 @@ import tensorframes_tpu as tft
 from tensorframes_tpu import obs
 from tensorframes_tpu.obs.metrics import DEFAULT_BUCKETS, MetricsRegistry
 
+pytestmark = pytest.mark.obs
+
 
 # ---------------------------------------------------------------------------
 # registry

@@ -1,0 +1,1117 @@
+"""Distributed tracing + crash flight recorder (ISSUE 10).
+
+The acceptance bar: ONE ``trace_id`` submitted via a W3C ``traceparent``
+header on ``POST /generate`` is reconstructible from the JSONL span sink
+across a chaos-injected mid-stream replica kill and failover replay;
+a kill -9'd dist-jobs worker's block shows claim → reclaim → record as
+one trace across two processes and epochs; a fatal engine step and a
+quarantined block each dump a debug bundle listed by ``GET /statusz``;
+and ``TFT_OBS=0`` disables the whole layer.
+
+Everything here is CPU-only, seeded, and deterministic; the suite is
+tier-1 (``make test-obs`` selects the observability marker).
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tft
+from tensorframes_tpu import obs
+from tensorframes_tpu.obs import flight
+from tensorframes_tpu.obs.metrics import MetricsRegistry
+from tensorframes_tpu.obs.tracing import TraceContext
+from tensorframes_tpu.utils import chaos, get_config, set_config
+
+pytestmark = pytest.mark.obs
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from tensorframes_tpu.models import TransformerLM
+
+    return TransformerLM.init(0, VOCAB, d_model=16, n_heads=4, max_len=64)
+
+
+@pytest.fixture
+def sink(tmp_path):
+    """A JSONL trace sink for the test, detached afterwards."""
+    path = tmp_path / "trace.jsonl"
+    obs.set_trace_sink(str(path))
+    yield path
+    obs.set_trace_sink(None)
+
+
+@pytest.fixture
+def bundle_dir(tmp_path):
+    """Debug bundles land in the test's tmp dir, recorder state reset."""
+    flight.reset()
+    old = get_config().debug_bundle_dir
+    set_config(debug_bundle_dir=str(tmp_path / "bundles"))
+    yield tmp_path / "bundles"
+    set_config(debug_bundle_dir=old)
+    flight.reset()
+
+
+def _events(path):
+    return [json.loads(ln) for ln in path.read_text().splitlines()]
+
+
+def _http(addr, req: bytes, timeout=120) -> bytes:
+    host, port_s = addr.rsplit(":", 1)
+    s = socket.create_connection((host, int(port_s)), timeout=timeout)
+    try:
+        s.sendall(req)
+        data = b""
+        while True:
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            data += chunk
+    finally:
+        s.close()
+    return data
+
+
+def _post_generate(addr, spec, headers=None, timeout=120):
+    body = json.dumps(spec).encode()
+    head = f"POST /generate HTTP/1.1\r\nContent-Length: {len(body)}\r\n"
+    for k, v in (headers or {}).items():
+        head += f"{k}: {v}\r\n"
+    resp = _http(addr, head.encode() + b"\r\n" + body, timeout=timeout)
+    status = int(resp.split(b" ", 2)[1])
+    raw_head, raw_body = resp.split(b"\r\n\r\n", 1)
+    resp_headers = {}
+    for line in raw_head.split(b"\r\n")[1:]:
+        name, _, val = line.partition(b":")
+        resp_headers[name.strip().lower().decode()] = val.strip().decode()
+    return status, json.loads(raw_body or b"{}"), resp_headers
+
+
+def _get_json(addr, path):
+    resp = _http(addr, f"GET {path} HTTP/1.1\r\n\r\n".encode())
+    status = int(resp.split(b" ", 2)[1])
+    return status, json.loads(resp.split(b"\r\n\r\n", 1)[1])
+
+
+# ---------------------------------------------------------------------------
+# trace context
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_traceparent_round_trip(self):
+        ctx = obs.new_trace()
+        assert re.fullmatch(r"[0-9a-f]{32}", ctx.trace_id)
+        assert re.fullmatch(r"[0-9a-f]{16}", ctx.span_id)
+        hdr = ctx.traceparent()
+        assert hdr == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+        back = TraceContext.from_traceparent(hdr)
+        assert back == ctx
+
+    def test_child_keeps_trace_changes_span(self):
+        ctx = obs.new_trace()
+        kid = ctx.child()
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id != ctx.span_id
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            None,
+            "",
+            "garbage",
+            "00-short-abcdefabcdefabcd-01",
+            "00-" + "0" * 32 + "-abcdefabcdefabcd-01",  # all-zero trace
+            "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span
+            "ff-" + "ab" * 16 + "-abcdefabcdefabcd-01",  # forbidden version
+            "00-" + "zz" * 16 + "-abcdefabcdefabcd-01",  # non-hex
+            "00-" + "ab" * 16 + "-abcdefabcdefabc-01",  # 15-char span
+        ],
+    )
+    def test_malformed_traceparent_degrades_to_none(self, bad):
+        assert TraceContext.from_traceparent(bad) is None
+
+    def test_case_and_whitespace_are_tolerated(self):
+        hdr = "  00-" + "AB" * 16 + "-ABCDEFABCDEFABCD-01  "
+        ctx = TraceContext.from_traceparent(hdr)
+        assert ctx is not None and ctx.trace_id == "ab" * 16
+
+
+class TestPropagation:
+    def test_spans_adopt_the_ambient_trace(self, sink):
+        ctx = obs.new_trace()
+        with obs.use_trace(ctx):
+            with obs.span("t.outer") as sp:
+                assert sp.trace_id == ctx.trace_id
+                assert sp.parent_id == ctx.span_id
+                with obs.span("t.inner") as inner:
+                    assert inner.trace_id == ctx.trace_id
+                    assert inner.parent_id == sp.span_id
+        # outside the block the ambient context is gone
+        assert obs.current_trace() is None
+        by = {e["name"]: e for e in _events(sink)}
+        assert by["t.inner"]["trace_id"] == ctx.trace_id
+        assert by["t.inner"]["parent_id"] == by["t.outer"]["span_id"]
+
+    def test_span_with_no_context_roots_a_fresh_trace(self, sink):
+        with obs.span("t.root") as sp:
+            assert re.fullmatch(r"[0-9a-f]{32}", sp.trace_id)
+            assert sp.parent_id is None
+
+    def test_current_trace_crosses_threads(self, sink):
+        handoff = {}
+        with obs.span("t.parent") as sp:
+            handoff["ctx"] = obs.current_trace()
+        assert handoff["ctx"].span_id == sp.span_id
+
+        def worker():
+            with obs.use_trace(handoff["ctx"]):
+                with obs.span("t.child"):
+                    pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        by = {e["name"]: e for e in _events(sink)}
+        assert by["t.child"]["trace_id"] == by["t.parent"]["trace_id"]
+        assert by["t.child"]["parent_id"] == by["t.parent"]["span_id"]
+
+    def test_event_is_written_immediately(self, sink):
+        with obs.span("t.enclosing") as sp:
+            ectx = obs.event("t.point", k="v")
+            # the span is still OPEN, but the point event is on disk
+            events = _events(sink)
+            assert [e["name"] for e in events] == ["t.point"]
+            assert events[0]["kind"] == "event"
+            assert events[0]["dur_s"] == 0.0
+            assert events[0]["parent_id"] == sp.span_id
+            assert events[0]["attrs"] == {"k": "v"}
+            assert ectx.trace_id == sp.trace_id
+
+    def test_span_ids_are_unique(self, sink):
+        with obs.span("t.a") as a:
+            pass
+        with obs.span("t.b") as b:
+            pass
+        assert a.span_id != b.span_id
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink rotation
+# ---------------------------------------------------------------------------
+
+
+class TestSinkRotation:
+    def test_size_rotation_keeps_last_bytes(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        obs.set_trace_sink(str(path), max_bytes=2048)
+        try:
+            for i in range(100):
+                with obs.span("t.rot", i=i, pad="x" * 80):
+                    pass
+        finally:
+            obs.set_trace_sink(None)
+        rolled = tmp_path / "trace.jsonl.1"
+        assert rolled.exists(), "sink never rotated"
+        assert path.stat().st_size <= 2048
+        assert rolled.stat().st_size <= 2048 + 200
+        # both files are whole-line valid JSONL and the newest span is
+        # in the live file (rotation is between-writes, never mid-line)
+        live = _events(path)
+        for e in live + _events(rolled):
+            assert e["name"] == "t.rot"
+        assert live[-1]["attrs"]["i"] == 99
+
+    def test_env_default_used_when_unspecified(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TFT_TRACE_FILE_MAX_BYTES", "1024")
+        path = tmp_path / "trace.jsonl"
+        obs.set_trace_sink(str(path))
+        try:
+            for i in range(50):
+                with obs.span("t.envrot", pad="y" * 80):
+                    pass
+        finally:
+            obs.set_trace_sink(None)
+        assert (tmp_path / "trace.jsonl.1").exists()
+
+    def test_zero_disables_rotation(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        obs.set_trace_sink(str(path), max_bytes=0)
+        try:
+            for i in range(50):
+                with obs.span("t.norot", pad="z" * 80):
+                    pass
+        finally:
+            obs.set_trace_sink(None)
+        assert not (tmp_path / "trace.jsonl.1").exists()
+        assert len(_events(path)) == 50
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition-format escaping (the audit's regression)
+# ---------------------------------------------------------------------------
+
+
+class TestPromEscaping:
+    def test_help_newline_and_backslash_escape(self):
+        """REGRESSION: an embedded newline in HELP text split the line
+        and corrupted every series after it in the scrape; backslashes
+        went through raw. The exposition format (0.0.4) escapes both."""
+        reg = MetricsRegistry()
+        reg.counter("t.helpesc_total", "line1\nline2 C:\\dir done").inc()
+        text = reg.render_prometheus()
+        lines = text.splitlines()
+        help_lines = [l for l in lines if l.startswith("# HELP")]
+        assert help_lines == [
+            "# HELP tft_t_helpesc_total line1\\nline2 C:\\\\dir done"
+        ]
+        # nothing leaked onto its own line
+        assert not any(l.startswith("line2") for l in lines)
+
+    def test_label_values_round_trip_a_scrape_parse(self):
+        """Exception text in a label value (the `status` reasons on
+        failure counters) must survive render → parse: backslash first,
+        then quote, then newline, per the exposition format."""
+        reg = MetricsRegistry()
+        nasty = 'RuntimeError: "quoted"\npath C:\\x \\n literal'
+        reg.counter("t.esc2_total", "x", labels=("status",)).inc(
+            status=nasty
+        )
+        text = reg.render_prometheus()
+        (line,) = [
+            l for l in text.splitlines() if l.startswith("tft_t_esc2_total{")
+        ]
+        assert "\n" not in line  # the rendered series is ONE line
+        m = re.fullmatch(r'tft_t_esc2_total\{status="(.*)"\} 1', line)
+        assert m, line
+        unescaped = (
+            m.group(1)
+            .replace("\\\\", "\x00")
+            .replace('\\"', '"')
+            .replace("\\n", "\n")
+            .replace("\x00", "\\")
+        )
+        assert unescaped == nasty
+
+    def test_every_rendered_line_is_valid_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("t.v_total", "a\nb", labels=("s",)).inc(s='x"\\\n')
+        reg.gauge("t.v", "g").set(1.5)
+        reg.histogram("t.v_seconds", "h").observe(0.1)
+        ok = re.compile(
+            r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+            r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9e+.infNa]+)$"
+        )
+        for line in reg.render_prometheus().splitlines():
+            assert ok.match(line), f"bad exposition line: {line!r}"
+
+
+# ---------------------------------------------------------------------------
+# multi-thread hammer on the registry while a scrape loop runs
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryHammer:
+    def test_no_lost_increments_under_concurrent_scrapes(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t.hammer_total", "c", labels=("op",))
+        g = reg.gauge("t.hammer_inflight", "g")
+        h = reg.histogram("t.hammer_seconds", "h")
+        per_thread, n_threads = 2000, 8
+        stop = threading.Event()
+        scrapes, scrape_errors = [], []
+
+        def scrape_loop():
+            while not stop.is_set():
+                try:
+                    text = reg.render_prometheus()
+                    reg.snapshot()
+                    scrapes.append(text)
+                except Exception as e:  # pragma: no cover
+                    scrape_errors.append(e)
+                    return
+
+        def hammer(i):
+            for k in range(per_thread):
+                c.inc(op=f"op{i % 2}")
+                g.adjust(1.0)
+                h.observe(1e-4 * (k % 7 + 1))
+                g.adjust(-1.0)
+
+        scraper = threading.Thread(target=scrape_loop)
+        scraper.start()
+        ts = [
+            threading.Thread(target=hammer, args=(i,))
+            for i in range(n_threads)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        stop.set()
+        scraper.join()
+        assert not scrape_errors, scrape_errors
+        assert scrapes, "the scrape loop never completed a pass"
+        total = per_thread * n_threads
+        assert c.value(op="op0") == total / 2
+        assert c.value(op="op1") == total / 2
+        assert g.value() == 0.0
+        assert h.series()["count"] == total
+        # the final scrape is valid and carries the exact totals
+        final = reg.render_prometheus()
+        assert f'tft_t_hammer_total{{op="op0"}} {int(total / 2)}' in final
+        assert f"tft_t_hammer_seconds_count {total}" in final
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_record_and_rings(self, bundle_dir):
+        flight.record("testring", "boom", a=1, b="x")
+        rings = flight.rings()
+        (evt,) = rings["testring"]
+        assert evt["kind"] == "boom" and evt["a"] == 1 and evt["b"] == "x"
+        assert evt["ts"] > 0
+
+    def test_ring_is_bounded(self, bundle_dir):
+        for i in range(600):
+            flight.record("bounded", "e", i=i)
+        evts = flight.rings()["bounded"]
+        assert len(evts) == 512  # TFT_FLIGHT_EVENTS default
+        assert evts[-1]["i"] == 599 and evts[0]["i"] == 88  # oldest evicted
+
+    def test_capture_spans_mirrors_spans_into_the_trace_ring(
+        self, bundle_dir
+    ):
+        # no sink, no annotations: with capture ON the span must still
+        # go live and land in the ring
+        flight.capture_spans(True)
+        try:
+            with obs.span("t.flightspan", k=1) as sp:
+                assert sp is not None
+            obs.event("t.flightevent")
+        finally:
+            flight.capture_spans(False)
+        names = [e["name"] for e in flight.rings()["trace"]]
+        assert "t.flightspan" in names and "t.flightevent" in names
+        # capture off again: spans short-circuit
+        with obs.span("t.dead") as sp:
+            assert sp is None
+
+    def test_dump_bundle_contents_and_registry(self, bundle_dir):
+        flight.record("testring", "precrash", n=7)
+        path = flight.dump_bundle(
+            "test_reason", health={"healthy": False}, extra={"why": "test"}
+        )
+        assert path is not None and os.path.exists(path)
+        assert os.path.dirname(path) == str(bundle_dir)
+        bundle = json.load(open(path))
+        assert bundle["reason"] == "test_reason"
+        assert bundle["version"] == 1
+        assert bundle["pid"] == os.getpid()
+        assert bundle["rings"]["testring"][0]["kind"] == "precrash"
+        assert "obs.debug_bundles_total" in bundle["metrics"]
+        assert bundle["health"] == {"healthy": False}
+        assert bundle["config"]["debug_bundle_dir"] == str(bundle_dir)
+        assert bundle["chaos_spec"] == ""
+        assert bundle["extra"] == {"why": "test"}
+        (rec,) = [
+            b
+            for b in flight.recent_bundles()
+            if b["reason"] == "test_reason"
+        ]
+        assert rec["path"] == path
+        assert flight.last_bundle()["path"] == path
+
+    def test_dump_bundle_debounces_crash_loops(self, bundle_dir):
+        p1 = flight.dump_bundle("loop_reason")
+        p2 = flight.dump_bundle("loop_reason")  # within the 1 s window
+        p3 = flight.dump_bundle("other_reason")  # different reason: dumps
+        assert p1 is not None and p2 is None and p3 is not None
+
+    def test_debounce_key_separates_distinct_failures(self, bundle_dir):
+        """Sibling failures of ONE reason milliseconds apart (several
+        blocks quarantining in a row) each get their bundle; only a
+        true repeat of the same unit is suppressed."""
+        p1 = flight.dump_bundle("q_reason", debounce_key="job/1")
+        p2 = flight.dump_bundle("q_reason", debounce_key="job/2")
+        p3 = flight.dump_bundle("q_reason", debounce_key="job/1")
+        assert p1 is not None and p2 is not None and p3 is None
+
+    def test_chaos_injections_land_in_the_ring(self, bundle_dir):
+        with chaos.scoped("jobs.block=latency:ms=1:times=1"):
+            chaos.site("jobs.block")
+        evts = flight.rings()["chaos"]
+        assert any(
+            e["kind"] == "latency" and e["site"] == "jobs.block"
+            for e in evts
+        )
+
+    def test_kill_switch_parity(self, bundle_dir):
+        set_config(observability=False)
+        try:
+            flight.record("offring", "e")
+            assert flight.dump_bundle("off_reason") is None
+            assert obs.event("t.off") is None
+            flight.capture_spans(True)
+            with obs.span("t.off2") as sp:
+                assert sp is None
+        finally:
+            flight.capture_spans(False)
+            set_config(observability=True)
+        assert "offring" not in flight.rings()
+        assert not any(
+            b["reason"] == "off_reason" for b in flight.recent_bundles()
+        )
+
+
+# ---------------------------------------------------------------------------
+# POST /generate tracing + /statusz (solo engine)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serve
+class TestGenerateTracing:
+    def test_traceparent_echo_timing_and_sink(self, lm, sink, bundle_dir):
+        from tensorframes_tpu.interop.serving import ScoringServer
+        from tensorframes_tpu.serve import GenerationEngine
+
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=48)
+        client = obs.new_trace()
+        # the server starts (and stops) the engine itself
+        with ScoringServer(engine=eng) as addr:
+            status, body, headers = _post_generate(
+                addr,
+                {"prompt": [1, 2, 3], "max_new_tokens": 6},
+                headers={"traceparent": client.traceparent()},
+            )
+            assert status == 200
+            # the response adopts the CLIENT's trace and echoes it
+            assert body["trace_id"] == client.trace_id
+            echoed = TraceContext.from_traceparent(headers["traceparent"])
+            assert echoed.trace_id == client.trace_id
+            assert echoed.span_id != client.span_id
+            timing = body["timing"]
+            assert timing["total_s"] > 0
+            assert timing["queue_wait_s"] >= 0
+            assert timing["prefill_s"] > 0
+            assert timing["decode_s"] >= 0
+            assert timing["prefill_chunks"] == 0
+            assert timing["replays"] == 0
+            # a malformed header degrades to a FRESH trace, not a 4xx
+            status, body2, _ = _post_generate(
+                addr,
+                {"prompt": [1, 2, 3], "max_new_tokens": 2},
+                headers={"traceparent": "00-garbage-zz-01"},
+            )
+            assert status == 200
+            assert re.fullmatch(r"[0-9a-f]{32}", body2["trace_id"])
+            assert body2["trace_id"] != client.trace_id
+
+            # /statusz: the request log carries the trace ids
+            status, sz = _get_json(addr, "/statusz")
+            assert status == 200
+            gens = [r for r in sz["requests"] if r["kind"] == "generate"]
+            assert {g["trace_id"] for g in gens} == {
+                body["trace_id"],
+                body2["trace_id"],
+            }
+            assert sz["slowest_requests"][0]["dur_s"] >= 0
+            assert sz["chaos"] == ""
+            assert sz["trace_sink"] is True
+            assert "serving" in sz["flight"]
+        # the whole request is ONE trace in the sink with correct
+        # parentage: serving.generate under the client's trace, the
+        # engine's prefill (another thread) under serving.generate
+        events = _events(sink)
+        (gen,) = [
+            e
+            for e in events
+            if e["name"] == "serving.generate"
+            and e["trace_id"] == client.trace_id
+        ]
+        assert gen["parent_id"] == echoed.span_id
+        prefills = [
+            e
+            for e in events
+            if e["name"] == "serve.prefill"
+            and e["trace_id"] == client.trace_id
+        ]
+        assert prefills, "engine prefill did not join the request trace"
+        assert all(p["parent_id"] == gen["span_id"] for p in prefills)
+
+    def test_statusz_and_healthz_list_bundles(self, lm, sink, bundle_dir):
+        from tensorframes_tpu.interop.serving import ScoringServer
+        from tensorframes_tpu.serve import GenerationEngine
+
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=48)
+        with ScoringServer(engine=eng) as addr:
+            path = flight.dump_bundle("statusz_test")
+            assert path is not None
+            status, sz = _get_json(addr, "/statusz")
+            assert status == 200
+            assert any(
+                b["reason"] == "statusz_test" and b["path"] == path
+                for b in sz["debug_bundles"]
+            )
+            status, hz = _get_json(addr, "/healthz")
+            assert status == 200
+            assert any(
+                b["reason"] == "statusz_test"
+                for b in hz["debug_bundles"]
+            )
+        # unknown paths advertise the new endpoint
+        # (routing itself is covered in test_fleet)
+
+
+# ---------------------------------------------------------------------------
+# engine fatal -> debug bundle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+class TestEngineFatalBundle:
+    def test_fatal_step_dumps_a_bundle(self, lm, bundle_dir):
+        from tensorframes_tpu.serve import GenerationEngine
+        from tensorframes_tpu.utils.chaos import ChaosFault
+
+        eng = GenerationEngine(lm, max_slots=2, page_size=4, max_seq_len=32)
+        with chaos.scoped("serve.decode_step=fatal:times=1"):
+            with eng:
+                h = eng.submit([1, 2, 3], 6)
+                with pytest.raises(ChaosFault):
+                    h.result(timeout=60)
+                # the handle fails from inside the step; the supervisor
+                # (unhealthy flip + bundle dump) lands a beat later
+                deadline = time.monotonic() + 10
+                bundles = []
+                while not bundles and time.monotonic() < deadline:
+                    bundles = [
+                        b
+                        for b in flight.recent_bundles()
+                        if b["reason"] == "engine_fatal"
+                    ]
+                    time.sleep(0.01)
+                assert not eng.healthy
+        assert bundles, "no engine_fatal bundle dumped"
+        bundle = json.load(open(bundles[0]["path"]))
+        assert bundle["extra"]["error_type"] == "ChaosFault"
+        assert bundle["health"]["healthy"] is False
+        # the serve ring captured the fatal, the chaos ring the injection
+        assert any(
+            e["kind"] == "engine_fatal" for e in bundle["rings"]["serve"]
+        )
+        assert any(
+            e["site"] == "serve.decode_step"
+            for e in bundle["rings"]["chaos"]
+        )
+        assert "serve.requests_total" in bundle["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# fleet failover: ONE trace across a mid-stream replica kill (acceptance)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+class TestFleetFailoverTrace:
+    def test_one_trace_across_replica_kill_and_replay(
+        self, lm, sink, bundle_dir
+    ):
+        from tensorframes_tpu.interop.serving import ScoringServer
+        from tensorframes_tpu.serve import Fleet
+        from tensorframes_tpu.utils.chaos import ChaosFault
+
+        fleet = Fleet(
+            lm, replicas=2, max_slots=4, page_size=4, max_seq_len=64,
+            watchdog_interval_s=0.02,
+        )
+        client = obs.new_trace()
+        result = {}
+
+        def call(addr):
+            result["resp"] = _post_generate(
+                addr,
+                {"prompt": [1, 2, 3], "max_new_tokens": 20},
+                headers={"traceparent": client.traceparent()},
+            )
+
+        with chaos.scoped("serve.decode_step=latency:ms=25"):
+            # the server starts (and stops) the fleet itself
+            with ScoringServer(engine=fleet) as addr:
+                t = threading.Thread(target=call, args=(addr,))
+                t.start()
+                # wait until SOME replica is streaming it, then kill it
+                deadline = time.monotonic() + 60
+                victim = None
+                while victim is None:
+                    assert time.monotonic() < deadline
+                    victim = next(
+                        (
+                            rep
+                            for rep in fleet._replicas
+                            if any(
+                                s is not None
+                                for s in rep.engine.scheduler.slots
+                            )
+                        ),
+                        None,
+                    )
+                    time.sleep(0.01)
+                fleet._kill_replica(victim, ChaosFault("mid-stream kill"))
+                t.join(timeout=120)
+                assert not t.is_alive()
+        status, body, _ = result["resp"]
+        assert status == 200
+        assert body["trace_id"] == client.trace_id
+        assert body["timing"]["replays"] >= 1
+        events = _events(sink)
+        ours = [e for e in events if e["trace_id"] == client.trace_id]
+        # the failover point is marked IN the same trace...
+        replays = [e for e in ours if e["name"] == "fleet.replay"]
+        assert replays and replays[0]["attrs"]["replay"] == 1
+        assert replays[0]["kind"] == "event"
+        # ...and the work spans exist on BOTH sides of the kill: one
+        # prefill dispatch per replica that served the stream, all in
+        # the client's trace, all parented under serving.generate
+        prefills = [e for e in ours if e["name"] == "serve.prefill"]
+        assert len(prefills) >= 2, (
+            "expected prefill spans from both replicas in one trace"
+        )
+        (gen,) = [e for e in ours if e["name"] == "serving.generate"]
+        assert all(p["parent_id"] == gen["span_id"] for p in prefills)
+        # the fence landed in the flight recorder's fleet ring
+        assert any(
+            e["kind"] == "fence" for e in flight.rings().get("fleet", [])
+        )
+
+
+# ---------------------------------------------------------------------------
+# batch jobs: journal-carried traces + quarantine bundles
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.durability
+class TestJobsTracing:
+    def test_manifest_and_ledger_carry_the_trace(
+        self, tmp_path, sink, bundle_dir
+    ):
+        from tensorframes_tpu.engine import run_job
+
+        old = get_config().max_rows_per_device_call
+        set_config(max_rows_per_device_call=16)
+        try:
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(96, 4)).astype(np.float32)
+            df = tft.TensorFrame.from_columns({"x": x}).analyze()
+            res = run_job(
+                "map_rows", lambda x: {"y": x * 2.0}, df,
+                job_dir=str(tmp_path / "job"),
+            )
+        finally:
+            set_config(max_rows_per_device_call=old)
+        manifest = json.load(open(os.path.join(res.path, "manifest.json")))
+        tid = manifest["trace_id"]
+        assert re.fullmatch(r"[0-9a-f]{32}", tid)
+        assert re.fullmatch(r"[0-9a-f]{16}", manifest["trace_span_id"])
+        # every done-record in the ledger carries the job trace + its
+        # block span id — the journal alone reconstructs the story
+        recs = [
+            json.loads(ln)
+            for ln in open(os.path.join(res.path, "ledger.jsonl"))
+            if '"done"' in ln
+        ]
+        assert recs and all(r["trace_id"] == tid for r in recs)
+        span_ids = {r["span_id"] for r in recs}
+        assert len(span_ids) == len(recs)  # one block span each
+        # and those span ids are REAL spans in the sink, under jobs.run
+        events = _events(sink)
+        by_id = {e["span_id"]: e for e in events}
+        (run_span,) = [
+            e
+            for e in events
+            if e["name"] == "jobs.run" and e["trace_id"] == tid
+        ]
+        for sid in span_ids:
+            assert by_id[sid]["name"] == "jobs.block"
+            assert by_id[sid]["trace_id"] == tid
+
+    def test_resume_continues_the_same_trace(self, tmp_path, sink):
+        from tensorframes_tpu.engine import resume_job, run_job
+
+        old = get_config().max_rows_per_device_call
+        set_config(max_rows_per_device_call=16)
+        try:
+            rng = np.random.default_rng(1)
+            x = rng.normal(size=(64, 4)).astype(np.float32)
+            df = tft.TensorFrame.from_columns({"x": x}).analyze()
+            fn = lambda x: {"y": x + 1.0}  # noqa: E731
+            res = run_job("map_rows", fn, df, job_dir=str(tmp_path / "j"))
+            tid = json.load(
+                open(os.path.join(res.path, "manifest.json"))
+            )["trace_id"]
+            res2 = resume_job(res.path, fn, df)
+        finally:
+            set_config(max_rows_per_device_call=old)
+        assert res2.blocks_restored > 0
+        tid2 = json.load(
+            open(os.path.join(res2.path, "manifest.json"))
+        )["trace_id"]
+        assert tid2 == tid
+        # the resume's jobs.run span is in the ORIGINAL trace
+        runs = [
+            e
+            for e in _events(sink)
+            if e["name"] == "jobs.run" and e["trace_id"] == tid
+        ]
+        assert len(runs) == 2
+
+    def test_quarantine_dumps_a_linked_bundle(
+        self, tmp_path, sink, bundle_dir
+    ):
+        from tensorframes_tpu.engine import load_quarantine, run_job
+
+        old = get_config().max_rows_per_device_call
+        set_config(max_rows_per_device_call=16)
+        try:
+            rng = np.random.default_rng(2)
+            x = rng.normal(size=(96, 4)).astype(np.float32)
+            df = tft.TensorFrame.from_columns({"x": x}).analyze()
+            with chaos.scoped("jobs.block=fatal:every=2:times=1"):
+                res = run_job(
+                    "map_rows", lambda x: {"y": x * 3.0}, df,
+                    job_dir=str(tmp_path / "q"),
+                )
+        finally:
+            set_config(max_rows_per_device_call=old)
+        (qb,) = res.quarantined
+        assert qb.debug_bundle and os.path.exists(qb.debug_bundle)
+        # quarantine.json links the bundle — the post-mortem starts from
+        # load_quarantine alone
+        (qb2,) = load_quarantine(res.path)
+        assert qb2.debug_bundle == qb.debug_bundle
+        bundle = json.load(open(qb.debug_bundle))
+        assert bundle["reason"] == "block_quarantine"
+        assert bundle["extra"]["block"] == qb.index
+        assert bundle["extra"]["error_type"] == "ChaosFault"
+        tid = json.load(
+            open(os.path.join(res.path, "manifest.json"))
+        )["trace_id"]
+        assert bundle["extra"]["trace_id"] == tid
+        assert any(
+            e["kind"] == "quarantine" for e in bundle["rings"]["jobs"]
+        )
+        # the quarantine record in the ledger carries the trace too
+        recs = [
+            json.loads(ln)
+            for ln in open(os.path.join(res.path, "ledger.jsonl"))
+            if '"quarantined"' in ln
+        ]
+        assert recs and recs[0]["trace_id"] == tid
+
+
+# ---------------------------------------------------------------------------
+# dist jobs: claim -> kill -9 -> reclaim -> record as ONE trace across
+# two processes and epochs (acceptance)
+# ---------------------------------------------------------------------------
+
+_TRACED_WORKER = r"""
+import sys
+import numpy as np
+import tensorframes_tpu as tft
+
+path, wid, ttl = sys.argv[1:4]
+tft.utils.set_config(max_rows_per_device_call=16)
+x = np.arange(256, dtype=np.float32).reshape(64, 4)
+df = tft.TensorFrame.from_columns({"x": x}).analyze().repartition(2)
+rep = tft.run_worker(
+    "map_rows", lambda x: {"y": x * 3.0 + 1.0}, df, path=path,
+    worker_id=wid, lease_ttl_s=float(ttl), poll_s=0.2,
+)
+print("WORKER_EXIT", wid)
+"""
+
+
+def _spawn_traced_worker(path, wid, ttl, trace_file, chaos_spec=""):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TFT_TRACE_FILE=trace_file)
+    env.pop("TFT_CHAOS", None)
+    if chaos_spec:
+        env["TFT_CHAOS"] = chaos_spec
+    return subprocess.Popen(
+        [sys.executable, "-c", _TRACED_WORKER, path, wid, str(ttl)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _live_lease(path, worker_id):
+    lease_dir = os.path.join(path, "leases")
+    try:
+        names = os.listdir(lease_dir)
+    except FileNotFoundError:
+        return None
+    for n in sorted(names):
+        if not (n.startswith("block-") and n.endswith(".lease")):
+            continue
+        try:
+            with open(os.path.join(lease_dir, n)) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if d.get("worker") == worker_id and d.get("state") != "done":
+            return int(n.split(".e")[0][len("block-"):])
+    return None
+
+
+@pytest.mark.distjobs
+@pytest.mark.chaos
+class TestDistKillTrace:
+    def test_claim_reclaim_record_is_one_trace_across_processes(
+        self, tmp_path
+    ):
+        """The acceptance post-mortem: worker A claims a block and is
+        kill -9'd mid-compute; worker B (a different process) reclaims
+        it at epoch 1 and records it. ``manifest.json`` +
+        ``ledger.jsonl`` + the JSONL trace sink — written by TWO
+        processes, read by a THIRD that computed nothing — reconstruct
+        claim → reclaim → record as one ``trace_id``."""
+        path = str(tmp_path / "job")
+        trace_file = str(tmp_path / "trace.jsonl")
+        # A stalls forever inside its first block (chaos latency) while
+        # heartbeating, so its lease is live until the SIGKILL
+        victim = _spawn_traced_worker(
+            path, "w-a", 1.5, trace_file,
+            chaos_spec="jobs.block=latency:ms=120000",
+        )
+        drainer = None
+        try:
+            deadline = time.monotonic() + 120
+            victim_block = None
+            while victim_block is None:
+                assert time.monotonic() < deadline, (
+                    "victim never claimed a lease: "
+                    + victim.stderr.read()
+                    if victim.poll() is not None
+                    else "victim never claimed a lease"
+                )
+                assert victim.poll() is None, victim.stderr.read()
+                victim_block = _live_lease(path, "w-a")
+                if victim_block is None:
+                    time.sleep(0.1)
+            # the claim's point event lands microseconds after the lease
+            # file — but on a loaded one-core host the worker can be
+            # descheduled in between. Waiting for it does not weaken the
+            # kill: the chaos stall pins the worker INSIDE the block for
+            # 120 s, so this is still a genuine mid-compute death.
+            def claim_on_disk():
+                try:
+                    return any(
+                        '"jobs.lease.claim"' in ln
+                        for ln in open(trace_file)
+                    )
+                except OSError:
+                    return False
+
+            while not claim_on_disk():
+                assert time.monotonic() < deadline, (
+                    "victim's claim event never reached the sink"
+                )
+                time.sleep(0.05)
+            victim.send_signal(signal.SIGKILL)
+            assert victim.wait(timeout=30) == -signal.SIGKILL
+            # B drains the journal after A's lease expires
+            drainer = _spawn_traced_worker(path, "w-b", 20.0, trace_file)
+            out_b = drainer.communicate(timeout=240)
+            assert drainer.returncode == 0, out_b[1][-4000:]
+        finally:
+            for p in (victim, drainer):
+                if p is not None and p.poll() is None:
+                    p.kill()
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        tid = manifest["trace_id"]
+        assert re.fullmatch(r"[0-9a-f]{32}", tid)
+        # the ledger: the victim's block was recorded ONCE, at epoch 1,
+        # by the reclaiming worker, in the job's trace
+        recs = [
+            json.loads(ln)
+            for ln in open(os.path.join(path, "ledger.jsonl"))
+            if '"done"' in ln
+        ]
+        assert len(recs) == 4  # 64 rows / 16-chunks
+        (vrec,) = [r for r in recs if r["block"] == victim_block]
+        assert vrec["epoch"] == 1 and vrec["worker"] == "w-b"
+        assert all(r["trace_id"] == tid for r in recs)
+        # the trace sink (shared by both PROCESSES): the dead worker's
+        # claim survived as a point event, and the reclaim is the same
+        # trace one epoch later
+        events = [
+            json.loads(ln) for ln in open(trace_file)
+        ]
+        claims = [
+            e
+            for e in events
+            if e["name"] == "jobs.lease.claim"
+            and e["attrs"]["block"] == victim_block
+        ]
+        assert {c["trace_id"] for c in claims} == {tid}
+        by_worker = {c["attrs"]["worker"]: c for c in claims}
+        assert by_worker["w-a"]["attrs"]["epoch"] == 0
+        assert by_worker["w-a"]["attrs"]["reclaim"] is False
+        assert by_worker["w-b"]["attrs"]["epoch"] == 1
+        assert by_worker["w-b"]["attrs"]["reclaim"] is True
+        # the reclaimed block's compute span is in the same trace, and
+        # the ledger's span_id points at a real span in the sink
+        by_id = {e["span_id"]: e for e in events}
+        assert by_id[vrec["span_id"]]["name"] == "jobs.block"
+        assert by_id[vrec["span_id"]]["trace_id"] == tid
+        # two distinct processes minted ids in one trace: the span-id
+        # process prefixes differ between A's claim and B's record
+        assert (
+            by_worker["w-a"]["span_id"][:8]
+            != by_worker["w-b"]["span_id"][:8]
+        )
+
+
+# ---------------------------------------------------------------------------
+# docs <-> code drift (mirror of the chaos-site drift test)
+# ---------------------------------------------------------------------------
+
+
+class TestDocsDrift:
+    @staticmethod
+    def _doc_tables():
+        """(metric_names, span_names) documented in the first column of
+        docs/observability.md's `| name | ... |` / `| span | ... |`
+        tables."""
+        from pathlib import Path
+
+        doc = (
+            Path(__file__).resolve().parent.parent
+            / "docs"
+            / "observability.md"
+        ).read_text()
+        metric_names, span_names = set(), set()
+        current = None
+        for line in doc.splitlines():
+            if not line.startswith("|"):
+                current = None
+                continue
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            if not cells:
+                continue
+            if cells[0] == "name":
+                current = metric_names
+                continue
+            if cells[0] == "span":
+                current = span_names
+                continue
+            if current is None or set(cells[0]) <= {"-", " "}:
+                continue
+            m = re.match(r"`([^`]+)`", cells[0])
+            if m:
+                current.add(m.group(1))
+        return metric_names, span_names
+
+    @staticmethod
+    def _package_spans():
+        """Span/event names referenced as literals in package source."""
+        from pathlib import Path
+
+        import tensorframes_tpu
+
+        root = Path(tensorframes_tpu.__file__).parent
+        pat = re.compile(
+            r"""(?<![A-Za-z0-9_])(?:_span|span|_trace_event|event)"""
+            r"""\(\s*["']([^"']+)["']""",
+        )
+        found = set()
+        for p in sorted(root.rglob("*.py")):
+            for m in pat.finditer(p.read_text()):
+                if "." in m.group(1):
+                    found.add(m.group(1))
+        return found
+
+    @staticmethod
+    def _registered_metrics():
+        # import every module that registers series so the registry is
+        # fully populated (the scrape of a live server sees the same)
+        import tensorframes_tpu.data.packer  # noqa: F401
+        import tensorframes_tpu.engine.dist_jobs  # noqa: F401
+        import tensorframes_tpu.engine.jobs  # noqa: F401
+        import tensorframes_tpu.frame.transfer  # noqa: F401
+        import tensorframes_tpu.interop.serving  # noqa: F401
+        import tensorframes_tpu.obs.flight  # noqa: F401
+        import tensorframes_tpu.serve.engine  # noqa: F401
+        import tensorframes_tpu.serve.fleet  # noqa: F401
+        import tensorframes_tpu.utils.chaos  # noqa: F401
+        import tensorframes_tpu.utils.failures  # noqa: F401
+        import tensorframes_tpu.utils.profiling  # noqa: F401
+
+        return {
+            n
+            for n in obs.registry().names()
+            if not n.startswith("t.")  # test-local scratch series
+        }
+
+    def test_every_documented_name_exists_in_the_package(self):
+        """A docs table naming a series/span the package no longer emits
+        lies to the operator reading a dashboard. Lazily-registered
+        series (``profiling.timer_seconds``) fall back to a source-text
+        mention, like the chaos drift test's composed-name escape."""
+        from pathlib import Path
+
+        import tensorframes_tpu
+
+        metric_names, span_names = self._doc_tables()
+        assert metric_names and span_names, "doc tables failed to parse"
+        registered = self._registered_metrics()
+        sources = "\n".join(
+            p.read_text()
+            for p in sorted(
+                Path(tensorframes_tpu.__file__).parent.rglob("*.py")
+            )
+        )
+        ghosts = [
+            n
+            for n in metric_names
+            if n not in registered and f'"{n}"' not in sources
+        ]
+        assert not ghosts, f"documented metrics missing from package: {ghosts}"
+        pkg_spans = self._package_spans()
+        ghost_spans = [n for n in span_names if n not in pkg_spans]
+        assert not ghost_spans, (
+            f"documented spans missing from package: {ghost_spans}"
+        )
+
+    def test_every_registered_series_is_documented(self):
+        metric_names, _ = self._doc_tables()
+        undocumented = sorted(self._registered_metrics() - metric_names)
+        assert not undocumented, (
+            f"registered series missing from docs/observability.md "
+            f"tables: {undocumented} — document them so operators can "
+            f"find what a dashboard shows"
+        )
+
+    def test_every_package_span_is_documented(self):
+        _, span_names = self._doc_tables()
+        undocumented = sorted(self._package_spans() - span_names)
+        assert not undocumented, (
+            f"package spans missing from the docs span catalog: "
+            f"{undocumented}"
+        )
