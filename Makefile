@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs verify bench bench-serve bench-attn bench-jobs bench-ingest bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -70,6 +70,13 @@ test-fleet:
 test-obs:
 	$(PY) -m pytest tests/ -q -m obs
 
+# the logical-plan suite (engine/plan.py: lazy op recording, map
+# fusion, column pruning, reduction hoisting — incl. the per-pass
+# byte-identity matrix and the journaled fused-pipeline kill+resume)
+# — fast, CPU-only, deterministic; part of tier-1
+test-plan:
+	$(PY) -m pytest tests/ -q -m plan
+
 # just the real 2-process distributed suite
 test-multihost:
 	$(PY) -m pytest tests/test_multihost.py -q
@@ -101,6 +108,13 @@ bench-jobs:
 # clock (one JSON line; TFT_BENCH_INGEST_ROWS shrinks it for smoke runs)
 bench-ingest:
 	$(PY) bench.py ingest
+
+# logical-plan pipeline: a 3-op map chain + reduce, fused vs
+# op-at-a-time — rows/s, framework overhead per logical op, and the
+# h2d byte delta from column pruning (one JSON line;
+# TFT_BENCH_PIPELINE_ROWS / _OPS shrink it for smoke runs)
+bench-pipeline:
+	$(PY) bench.py pipeline
 
 # all BASELINE configs + extras
 bench-all:

@@ -47,6 +47,14 @@ monolithic vs chunked-overlapped h2d/d2h GB/s on the same 3.1 GB
 column, plus the cold ingest→upload→score wall clock. Also exactly one
 JSON line.
 
+``python bench.py pipeline`` (``make bench-pipeline``) benchmarks the
+lazy logical-plan layer (``tensorframes_tpu/engine/plan.py``): a 3-op
+map chain + reduce fused vs op-at-a-time — rows/s, framework overhead
+per logical op, and the h2d byte delta from column pruning (a decoy
+column bound only by a dead op must never cross the link). Also
+exactly one JSON line; ``TFT_BENCH_PIPELINE_ROWS`` / ``_OPS`` shrink
+it for smoke runs.
+
 ``python bench.py map_rows`` (``make bench-jobs``) benchmarks the
 durable batch-job layer and its distributed drain: journal on/off
 overhead, plus a K-subprocess workers axis (``TFT_BENCH_JOB_WORKERS``,
@@ -746,6 +754,199 @@ def main_paged_attn():
     )
 
 
+def main_pipeline():
+    """Logical-plan pipeline bench (``make bench-pipeline``): a 3-op
+    map chain + ``reduce_blocks`` through the lazy plan layer
+    (``engine/plan.py``), fused vs op-at-a-time — one JSON line with:
+
+    - **rows/s** for the full pipeline in both modes (real compute:
+      ``d×d`` matmul per op on ``TFT_BENCH_PIPELINE_ROWS`` rows);
+    - **framework overhead per logical op** in both modes, measured on
+      a deliberately tiny frame where compute is negligible (min over
+      repetitions, divided by the number of logical ops) — the
+      acceptance bar is fused ≤ ½ op-at-a-time;
+    - the **h2d byte delta** from column pruning: the source carries a
+      decoy column bound only by a dead op (its fetch is never
+      demanded), so the fused run must upload exactly the live
+      column's bytes while the op-at-a-time run uploads both.
+
+    Knobs: ``TFT_BENCH_PIPELINE_ROWS`` (default 200000),
+    ``TFT_BENCH_PIPELINE_OPS`` (chain length, default 3)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.obs import metrics as _metrics
+    from tensorframes_tpu.utils import set_config
+
+    tft.enable_compilation_cache()
+    n_rows = int(os.environ.get("TFT_BENCH_PIPELINE_ROWS", "200000"))
+    n_ops = max(2, int(os.environ.get("TFT_BENCH_PIPELINE_OPS", "3")))
+    d = 64
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, d)).astype(np.float32)
+    decoy = rng.normal(size=(n_rows, 32)).astype(np.float32)
+    ws = [
+        jnp.asarray(
+            (rng.normal(size=(d, d)) / np.sqrt(d)).astype(np.float32)
+        )
+        for _ in range(n_ops)
+    ]
+
+    def _mk(i, w):
+        # placeholder named per level via feed_dict; fetch h{i}, with
+        # the chain head named "out" so the reduce's `out_input`
+        # convention binds it directly (keeps the chain pure maps —
+        # the hoisting pass needs no projection in between)
+        name = "out" if i == n_ops - 1 else f"h{i}"
+        return lambda inp: {name: jnp.dot(inp, w)}
+
+    layers = [_mk(i, w) for i, w in enumerate(ws)]
+
+    def dead_fn(decoy):
+        return {"dead": decoy * 2.0}
+
+    def build(df):
+        cur = df
+        for i, fn in enumerate(layers):
+            src = "x" if i == 0 else f"h{i - 1}"
+            cur = tft.map_blocks(fn, cur, feed_dict={"inp": src})
+        # the decoy consumer: chained but never demanded downstream
+        cur = tft.map_blocks(dead_fn, cur)
+        return cur
+
+    # defined ONCE: a lambda recreated per call is a fresh function
+    # identity -> fresh capture -> fresh composite -> recompile per pass
+    def reduce_fn(out_input):
+        return {"out": out_input.sum(axis=0)}
+
+    def run_pipeline(df):
+        cur = build(df)
+        # the reduce demands only "out": the decoy op is dead, its
+        # column never uploads, and the pure-map chain hoists the
+        # reduce into the fused program's per-block epilogue
+        return tft.reduce_blocks(reduce_fn, cur)
+
+    def one_mode(plan_on, frame):
+        set_config(
+            plan_lazy_ops=plan_on,
+            plan_fuse_maps=plan_on,
+            plan_prune_columns=plan_on,
+            plan_hoist_reduce=plan_on,
+        )
+        # warmup compiles
+        run_pipeline(frame)
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run_pipeline(frame)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        return dt
+
+    df = tft.TensorFrame.from_columns({"x": x, "decoy": decoy}).analyze()
+    dt_fused = one_mode(True, df)
+    dt_eager = one_mode(False, df)
+
+    # framework overhead per logical op: a frame small enough that the
+    # chain's compute is measured in microseconds, so the wall clock IS
+    # the per-op framework cost (capture memo, validation, span,
+    # dispatch, materialization) — the quantity fusion collapses
+    tiny = tft.TensorFrame.from_columns(
+        {"x": x[:64], "decoy": decoy[:64]}
+    ).analyze()
+    n_logical = n_ops + 2  # maps + dead map + reduce
+
+    def overhead(plan_on, reps):
+        set_config(
+            plan_lazy_ops=plan_on,
+            plan_fuse_maps=plan_on,
+            plan_prune_columns=plan_on,
+            plan_hoist_reduce=plan_on,
+        )
+        run_pipeline(tiny)  # warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run_pipeline(tiny)
+            best = min(best, time.perf_counter() - t0)
+        return best / n_logical
+
+    # alternate the two modes across rounds so scheduler/thermal noise
+    # on a shared host cannot land entirely on one side of the ratio;
+    # min-of-all is the overhead estimate
+    ov_fused, ov_eager = float("inf"), float("inf")
+    for _ in range(3):
+        ov_fused = min(ov_fused, overhead(True, 25))
+        ov_eager = min(ov_eager, overhead(False, 25))
+
+    # h2d bytes: fresh frames so every upload actually crosses the link
+    reg = _metrics.registry()
+
+    def h2d_delta(plan_on):
+        set_config(
+            plan_lazy_ops=plan_on,
+            plan_fuse_maps=plan_on,
+            plan_prune_columns=plan_on,
+            plan_hoist_reduce=plan_on,
+        )
+        fresh = tft.TensorFrame.from_columns(
+            {"x": x, "decoy": decoy}
+        ).analyze()
+        h0 = reg.get("frame.h2d_bytes_total").value()
+        run_pipeline(fresh)
+        return int(reg.get("frame.h2d_bytes_total").value() - h0)
+
+    h2d_fused = h2d_delta(True)
+    h2d_eager = h2d_delta(False)
+    set_config(
+        plan_lazy_ops=True, plan_fuse_maps=True,
+        plan_prune_columns=True, plan_hoist_reduce=True,
+    )
+
+    print(
+        json.dumps(
+            {
+                "bench": "tensorframes_tpu.pipeline",
+                "config": {
+                    "workload": (
+                        f"{n_ops}-op map chain (d={d} matmuls) + dead "
+                        f"decoy op + hoisted reduce_blocks, "
+                        f"{n_rows} rows"
+                    ),
+                    "device": str(jax.devices()[0]),
+                    "rows": n_rows,
+                    "chain_ops": n_ops,
+                },
+                "rows_per_s": {
+                    "fused": round(n_rows / dt_fused, 1),
+                    "op_at_a_time": round(n_rows / dt_eager, 1),
+                    "speedup": round(dt_eager / dt_fused, 3),
+                },
+                "framework_overhead_ms_per_logical_op": {
+                    "fused": round(ov_fused * 1e3, 4),
+                    "op_at_a_time": round(ov_eager * 1e3, 4),
+                    "reduction": round(ov_eager / ov_fused, 2),
+                },
+                "h2d_bytes_per_cold_run": {
+                    "fused_pruned": h2d_fused,
+                    "op_at_a_time": h2d_eager,
+                    "live_column_bytes": int(x.nbytes),
+                    "pruned_decoy_bytes": int(decoy.nbytes),
+                },
+                "transfer": _transfer_settings(),
+            }
+        )
+    )
+    # the pruning contract, asserted on the numbers just printed: the
+    # fused run uploads exactly the live column; the decoy column's
+    # bytes cross only in the op-at-a-time run
+    assert h2d_fused == x.nbytes, (h2d_fused, x.nbytes)
+    assert h2d_eager == x.nbytes + decoy.nbytes, (h2d_eager,)
+
+
 def main_map_rows_journal():
     """Durable-job overhead: one ``map_rows`` workload through
     ``run_job`` with the journal off (in-memory ledger: the same
@@ -1110,5 +1311,7 @@ if __name__ == "__main__":
         main_map_rows_journal()
     elif len(sys.argv) > 1 and sys.argv[1] == "ingest":
         main_ingest()
+    elif len(sys.argv) > 1 and sys.argv[1] == "pipeline":
+        main_pipeline()
     else:
         main()

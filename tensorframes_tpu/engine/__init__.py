@@ -1,5 +1,6 @@
 """Local execution engine: the nine-function public API over one device."""
 
+from . import plan as plan  # logical-plan layer (registers its metrics)
 from .ops import (
     map_blocks,
     precompile,

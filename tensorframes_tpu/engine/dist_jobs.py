@@ -825,9 +825,21 @@ def run_worker(
     one flaky dispatch would force pointless reclamation — and only
     then fails (resumable, like the single-process job). Fatal errors
     propagate immediately; blocks this worker had already recorded stay
-    recorded either way."""
+    recorded either way.
+
+    ``op="pipeline"``: drain a journaled **fused logical plan** — pass
+    the same pending planned frame as ``data`` (``fetches=None``); the
+    chain lowers to one composite op with a deterministic fingerprint,
+    so K workers drain the fused pipeline exactly like a single op
+    (``engine/plan.py``, docs/pipelines.md)."""
     from ..utils import get_config
 
+    if op == "pipeline":
+        from .plan import lower_for_job
+
+        op, fetches, data, consts, _post = lower_for_job(data)
+        if constants is None:
+            constants = consts
     if op not in _OPS:
         raise ValueError(f"unknown job op {op!r}; expected one of {_OPS}")
     cfg = get_config()

@@ -1173,10 +1173,31 @@ def run_job(
     job end instead of returning a partial :class:`JobResult` — healthy
     blocks still complete and journal first, so a later
     ``resume_job(retry_quarantined=True)`` only re-attempts the poison.
+
+    ``op="pipeline"`` journals a whole **fused logical plan**
+    (``engine/plan.py``, docs/pipelines.md): ``data`` is a pending lazy
+    planned frame (a chain of map ops, optionally trailed by
+    select/filter), ``fetches`` must be ``None``. The chain lowers to
+    ONE engine op with a deterministic composite program, so the
+    pipeline canonicalizes to one manifest fingerprint — it journals,
+    resumes, and distributes exactly like a single op, and trailing
+    select/filter nodes replay on the assembled result.
     """
     from ..utils import get_config
 
     cfg = get_config()
+    post = None
+    if op == "pipeline":
+        if fetches is not None:
+            raise ValueError(
+                "run_job('pipeline', ...) derives the program from the "
+                "planned frame; pass fetches=None"
+            )
+        from . import plan as _plan_mod
+
+        op, fetches, data, consts, post = _plan_mod.lower_for_job(data)
+        if constants is None:
+            constants = consts
     if journal is None:
         journal = cfg.journal_batch_jobs
     if strict is None:
@@ -1189,10 +1210,13 @@ def run_job(
         root = job_dir or cfg.job_dir or _default_job_dir()
         path = os.path.join(root, job_id)
     ledger = BlockLedger.create(path, job_id, op)
-    return _drive(
+    result = _drive(
         ledger, fetches, data, strict=strict, trim=trim,
         feed_dict=feed_dict, constants=constants, resumed=False,
     )
+    if post is not None:
+        result.completed = post(result.completed)
+    return result
 
 
 def resume_job(
@@ -1223,9 +1247,22 @@ def resume_job(
     in particular, ``retry_quarantined=True`` clearing
     ``quarantine.json`` under an active drain would race the live job.
     Use :func:`~tensorframes_tpu.engine.dist_jobs.wait_job` to assemble
-    a distributed job's result instead."""
+    a distributed job's result instead.
+
+    A journaled **pipeline** (``run_job("pipeline", ...)``) resumes the
+    same way: pass ``fetches=None`` and the same pending planned frame
+    as ``data`` — the chain re-lowers to the identical composite
+    program (one canonical fingerprint) and trailing select/filter
+    nodes replay on the assembled result."""
     from .dist_jobs import journal_guard
 
+    post = None
+    if fetches is None and getattr(data, "_plan_node", None) is not None:
+        from . import plan as _plan_mod
+
+        _kind, fetches, data, consts, post = _plan_mod.lower_for_job(data)
+        if constants is None:
+            constants = consts
     with journal_guard(path, what="resume_job"):
         ledger = BlockLedger.open_(path)
         if retry_quarantined:
@@ -1235,7 +1272,10 @@ def resume_job(
             from ..utils import get_config
 
             strict = not get_config().quarantine_blocks
-        return _drive(
+        result = _drive(
             ledger, fetches, data, strict=strict, trim=trim,
             feed_dict=feed_dict, constants=constants, resumed=True,
         )
+    if post is not None:
+        result.completed = post(result.completed)
+    return result

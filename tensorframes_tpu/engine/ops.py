@@ -421,6 +421,7 @@ def map_blocks(
     constants: Optional[Dict[str, Any]] = None,
     decoders: Optional[Dict[str, Callable]] = None,
     _ledger=None,
+    _plan: bool = True,
 ) -> TensorFrame:
     """Transform the frame block by block; fetches become new columns
     (``trim=False``) or the entire output (``trim=True``, row count may
@@ -966,6 +967,18 @@ def map_blocks(
         _m_rows_map_blocks.inc(parent.num_rows)
         return out
 
+    if _plan and _ledger is None and not trim and not decode_fns:
+        from . import plan as _plan_mod
+
+        if _plan_mod.enabled():
+            # record a logical-plan node: chained ops fuse/prune/hoist
+            # at force time (docs/pipelines.md); trim maps and decoder
+            # passes stay op-at-a-time (they change row counts / probe
+            # host data) and act as chain boundaries
+            return _plan_mod.make_lazy_map(
+                "map_blocks", parent, g, binding, fetch_names,
+                result_info, thunk, constants=constants,
+            )
     return TensorFrame(
         {}, result_info, num_partitions=parent.num_partitions, _thunk=thunk
     )
@@ -1627,6 +1640,7 @@ def map_rows(
     feed_dict: Optional[Dict[str, str]] = None,
     decoders: Optional[Dict[str, Callable]] = None,
     _ledger=None,
+    _plan: bool = True,
 ) -> TensorFrame:
     """Transform row by row (``core.py:223-264``). Rows with equal cell
     shapes are batched and executed with ``vmap`` in one XLA program per
@@ -1755,6 +1769,16 @@ def map_rows(
             explicit_h2d=True,
         )
 
+    if _plan and _ledger is None and not host_mode:
+        from . import plan as _plan_mod
+
+        if _plan_mod.enabled():
+            # logical-plan node (docs/pipelines.md); binary/host-path
+            # programs stay op-at-a-time and bound the chain
+            return _plan_mod.make_lazy_map(
+                "map_rows", parent, g, binding, fetch_names,
+                result_info, thunk,
+            )
     return TensorFrame(
         {}, result_info, num_partitions=parent.num_partitions, _thunk=thunk
     )
@@ -1791,14 +1815,35 @@ def reduce_blocks(fetches, dframe: TensorFrame, _ledger=None):
     per-partition partials spool to the journal, quarantined partitions
     drop out of the fold, and a resume folds restored + freshly-computed
     partials in partition order (byte-identical to a clean run). Returns
-    ``None`` when a journaled job quarantined every partition."""
+    ``None`` when a journaled job quarantined every partition.
+
+    Over a *pending planned* frame (a recorded map chain that has not
+    been forced) this is a plan terminal: with
+    ``Config.plan_hoist_reduce`` the reduce folds into the fused map
+    program's per-block epilogue, and either way the reduce's bindings
+    drive column pruning — the chain's dead ops never run and their
+    source columns never cross the link (``engine/plan.py``)."""
     with _span("engine.reduce_blocks", partitions=dframe.num_partitions):
-        out = _reduce_blocks_impl(fetches, dframe, _ledger)
-    _m_rows.inc(dframe.num_rows, op="reduce_blocks")
+        from . import plan as _plan_mod
+
+        handled, out, rows = (False, None, None)
+        if _plan_mod.enabled():
+            handled, out, rows = _plan_mod.reduce_terminal(
+                fetches, dframe, ledger=_ledger
+            )
+        if not handled:
+            out = _reduce_blocks_impl(fetches, dframe, _ledger)
+            rows = dframe.num_rows
+    _m_rows.inc(rows, op="reduce_blocks")
     return out
 
 
 def _reduce_blocks_impl(fetches, dframe: TensorFrame, ledger=None):
+    # NOTE: engine/plan.py's `_lower_hoisted_reduce` mirrors this drive
+    # (grouped async dispatch unjournaled, per-partition sync + spool
+    # journaled, OOM degrade to halved spans merged through the reduce
+    # program) with a fused maps+reduce partial program — a semantics
+    # change to retry/OOM/quarantine handling here must be applied there
     g = _as_graph(fetches, dframe, cell_inputs=False)
     binding = validate_reduce_block_graph(g, dframe.schema)
     _ensure_precision(g, dframe.schema)
@@ -2341,7 +2386,6 @@ def aggregate(fetches, grouped_data: GroupedFrame) -> TensorFrame:
     # tables, so nested spans (and per-pass row counts) show the recursion
     with _span("engine.aggregate", keys=",".join(grouped_data.keys)):
         out = _aggregate_impl(fetches, grouped_data)
-    _m_rows.inc(grouped_data.frame.num_rows, op="aggregate")
     return out
 
 
@@ -2353,6 +2397,16 @@ def _aggregate_impl(fetches, grouped_data: GroupedFrame) -> TensorFrame:
     g = _as_graph(fetches, dframe, cell_inputs=False)
     binding = validate_reduce_block_graph(g, dframe.schema)
     _ensure_precision(g, dframe.schema)
+    from . import plan as _plan_mod
+
+    if _plan_mod.enabled():
+        # aggregate is a plan terminal: a pending map chain executes as
+        # a demand-pruned fused view (bound inputs + group keys only);
+        # the lazy frame itself stays lazy — forcing it later yields its
+        # full schema (engine/plan.py, docs/pipelines.md)
+        dframe = _plan_mod.pruned_view(
+            dframe, set(binding.values()) | set(keys)
+        )
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -2361,6 +2415,7 @@ def _aggregate_impl(fetches, grouped_data: GroupedFrame) -> TensorFrame:
     n = dframe.num_rows
     if n == 0:
         raise ValueError("aggregate on an empty frame")
+    _m_rows.inc(n, op="aggregate")
 
     order, flags, emit_keys = _group_sort(dframe, keys, binding)
 
@@ -2509,8 +2564,17 @@ def analyze(dframe: TensorFrame) -> TensorFrame:
 
 def explain(dframe: TensorFrame) -> str:
     """Detailed schema string (reference ``DebugRowOps.explain``,
-    ``DebugRowOps.scala:528-545``)."""
-    return dframe.schema.explain()
+    ``DebugRowOps.scala:528-545``) — and, for a pending planned frame,
+    the logical plan first: recorded nodes, which rewrite passes fire,
+    pruned columns, and the fused program count (``engine/plan.py``).
+    Pure: rendering the plan neither forces the frame nor executes it."""
+    from . import plan as _plan_mod
+
+    schema_txt = dframe.schema.explain()
+    plan_txt = _plan_mod.explain_plan(dframe)
+    if plan_txt is None:
+        return schema_txt
+    return f"{plan_txt}\n== Schema ==\n{schema_txt}"
 
 
 def print_schema(dframe: TensorFrame) -> None:

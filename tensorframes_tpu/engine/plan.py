@@ -1,0 +1,1191 @@
+"""Lazy logical plans: op fusion, column pruning, reduction hoisting.
+
+The batch engine historically dispatched op-at-a-time: every ``map_rows``
+/ ``map_blocks`` round-tripped through its own compiled program and (for
+host-streamed data) its own transfer, so framework overhead plus data
+movement dominated chip time on HBM-bound pipelines. Following Relay's
+separation of a rewritable logical IR from lowering (PAPERS.md,
+arXiv:1810.00952), chained frame ops now *record plan nodes* instead of
+executing eagerly; the chain is optimized once when a fetch forces it
+and lowered to the ordinary dispatch in ``engine/ops.py`` — OOM halving,
+retries, chaos sites, and obs spans all intact.
+
+Rewrite passes (each independently toggleable via ``Config``, each
+byte-identity-tested against the unfused pipeline in tests/test_plan.py):
+
+1. **map fusion** (``plan_fuse_maps``): a run of chained maps collapses
+   into one jitted composite body — N logical ops, one compiled program,
+   one pass over the data. Row maps fusing into a block-lowered group
+   are lifted with ``jax.vmap`` (their per-row math is unchanged, so
+   results stay byte-identical to the op-at-a-time chain).
+2. **column pruning** (``plan_prune_columns``): liveness flows backward
+   from the terminal demand (a ``select``'s projection, a reduce's
+   bindings, an ``aggregate``'s bindings + keys); ops none of whose
+   fetches are live are dropped, so the source columns only they bound
+   are never uploaded — the ``frame.h2d_bytes_total`` delta is provable.
+   (Dead fetches of partially-live ops are dropped from the composite's
+   outputs too; XLA's DCE then removes their compute inside the body.)
+3. **reduction hoisting** (``plan_hoist_reduce``): a ``reduce_blocks``
+   terminal over a pending map chain folds into the map program's
+   per-block epilogue — the fused partial program computes map outputs
+   *and* the block partial in one dispatch, and partials still merge
+   through the reduce graph's own ``[2, ...]`` program (the exact merge
+   the unfused fold uses, so the fold is byte-identical).
+
+Laziness semantics (docs/pipelines.md): recording is cheap — capture,
+validation, and result-schema derivation still happen eagerly (errors
+surface at call sites, schemas are available without forcing); only the
+data work is deferred. Forcing a leaf executes its whole chain from the
+source; intermediate frames stay lazy (forcing one later re-runs its own
+prefix, byte-identically, with all compiled programs reused).
+``select`` / ``filter_rows`` on a planned frame record nodes too —
+``select`` is what gives the pruning pass its demand signal.
+
+Journal interaction: a fused plan lowers to ONE engine op with a
+deterministic composite graph, so it canonicalizes to one manifest
+fingerprint — ``run_job("pipeline", None, lazy_frame)`` journals the
+whole fused pipeline, resumes byte-identically across processes, and K
+distributed workers (``run_worker``) drain it exactly like a single op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..capture import CapturedGraph, TensorSpec
+from ..frame import TensorFrame
+from ..obs import span as _span
+from ..obs.metrics import counter as _counter
+from ..schema import ColumnInfo, FrameInfo, Unknown
+from ..utils import get_logger
+
+__all__ = [
+    "PlanOp",
+    "explain_plan",
+    "lower_for_job",
+    "make_lazy_map",
+    "pruned_view",
+    "record_filter",
+    "record_select",
+    "reduce_terminal",
+]
+
+logger = get_logger("plan")
+
+_MAP_KINDS = ("map_rows", "map_blocks")
+
+# -- plan telemetry (docs/observability.md) ---------------------------------
+_m_passes = _counter(
+    "plan.passes_total",
+    "Logical-plan rewrite passes that fired (changed the plan), by pass",
+    labels=("pass",),
+)
+_m_fused = _counter(
+    "plan.fused_ops_total",
+    "Logical ops absorbed into fused programs (map fusion absorbs the "
+    "ops of each multi-op group; reduction hoisting absorbs the reduce)",
+)
+_m_pruned = _counter(
+    "plan.pruned_columns_total",
+    "Columns pruned by the column-pruning pass: dead fetches dropped "
+    "from the plan plus source columns that never cross the link",
+)
+
+
+@dataclasses.dataclass
+class PlanOp:
+    """One recorded logical op. ``parent`` is the input frame (concrete,
+    legacy-lazy, or itself planned — chains are walked through pending
+    ``_plan_node`` links). Map nodes carry everything the eager prologue
+    already derived (graph, binding, result schema) so lowering never
+    re-validates; ``select`` / ``filter_rows`` nodes carry their
+    projection / mask."""
+
+    kind: str  # "map_rows" | "map_blocks" | "select" | "filter_rows"
+    parent: TensorFrame
+    result_info: FrameInfo
+    graph: Optional[CapturedGraph] = None
+    binding: Optional[Dict[str, str]] = None  # placeholder -> input column
+    fetch_names: Tuple[str, ...] = ()
+    constants: Optional[Dict[str, np.ndarray]] = None  # map_blocks only
+    select_cols: Optional[Tuple[Tuple[str, str], ...]] = None  # (src, dst)
+    filter_mask: Optional[np.ndarray] = None
+
+
+def _cfg():
+    from ..utils import get_config
+
+    return get_config()
+
+
+def _planned(frame) -> Optional[PlanOp]:
+    """The frame's pending plan node, or None when the frame is concrete
+    (already forced) or was built outside the plan layer."""
+    node = getattr(frame, "_plan_node", None)
+    if node is None or frame._thunk is None:
+        return None
+    return node
+
+
+def _chain(leaf: PlanOp) -> Tuple[TensorFrame, List[PlanOp]]:
+    """Walk pending plan links root-ward. Returns ``(source, ops)`` with
+    ``ops`` in execution order; the walk stops at the first frame that is
+    concrete or has no plan node (a forced intermediate acts as a
+    materialized source — its prefix never recomputes)."""
+    ops = [leaf]
+    f = leaf.parent
+    while True:
+        node = _planned(f)
+        if node is None:
+            break
+        ops.append(node)
+        f = node.parent
+    ops.reverse()
+    return f, ops
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    return bool(_cfg().plan_lazy_ops)
+
+
+def make_lazy_map(
+    kind: str,
+    parent: TensorFrame,
+    graph: CapturedGraph,
+    binding: Dict[str, str],
+    fetch_names: Sequence[str],
+    result_info: FrameInfo,
+    legacy_thunk: Callable[[], TensorFrame],
+    constants: Optional[Dict[str, Any]] = None,
+) -> TensorFrame:
+    """Build the lazy result frame for a map op, carrying both a plan
+    node (for chain optimization) and the op's own legacy thunk (the
+    byte-identity reference path, used whenever no rewrite applies)."""
+    node = PlanOp(
+        kind=kind,
+        parent=parent,
+        result_info=result_info,
+        graph=graph,
+        binding=dict(binding),
+        fetch_names=tuple(fetch_names),
+        constants=(
+            {k: np.asarray(v) for k, v in constants.items()}
+            if constants
+            else None
+        ),
+    )
+    frame = TensorFrame(
+        {},
+        result_info,
+        num_partitions=parent.num_partitions,
+        _thunk=lambda: execute(node, legacy_thunk),
+    )
+    frame._plan_node = node
+    return frame
+
+
+def record_select(parent: TensorFrame, cols: Sequence) -> TensorFrame:
+    """Lazy ``select`` on a planned frame: validates the projection
+    against the (already known) schema without forcing, and records the
+    node that gives the pruning pass its demand signal."""
+    info = parent.schema
+    pairs: List[Tuple[str, str]] = []
+    new_infos: List[ColumnInfo] = []
+    for c in cols:
+        src, dst = (c, c) if isinstance(c, str) else c
+        if src not in info:
+            raise KeyError(f"No column {src!r}; columns: {info.names}")
+        pairs.append((src, dst))
+        new_infos.append(info[src].with_name(dst))
+    result_info = FrameInfo(new_infos)
+    node = PlanOp(
+        kind="select",
+        parent=parent,
+        result_info=result_info,
+        select_cols=tuple(pairs),
+    )
+    frame = TensorFrame(
+        {},
+        result_info,
+        num_partitions=parent.num_partitions,
+        _thunk=lambda: execute(node, None),
+    )
+    frame._plan_node = node
+    return frame
+
+
+def record_filter(parent: TensorFrame, mask) -> TensorFrame:
+    """Lazy ``filter_rows`` on a planned frame. The mask is snapshotted
+    (it is host data the caller could mutate before the force)."""
+    node = PlanOp(
+        kind="filter_rows",
+        parent=parent,
+        result_info=parent.schema,
+        filter_mask=np.array(mask),
+    )
+    frame = TensorFrame(
+        {},
+        parent.schema,
+        num_partitions=parent.num_partitions,
+        _thunk=lambda: execute(node, None),
+    )
+    frame._plan_node = node
+    return frame
+
+
+# ---------------------------------------------------------------------------
+# the optimizer: liveness (pruning) + grouping (fusion)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Stage:
+    """One lowering stage after optimization: a fused group of ≥2 maps,
+    a single map executed through its own legacy graph, or a post-op."""
+
+    kind: str  # "fused" | "map" | "select" | "filter_rows"
+    ops: List[PlanOp]
+    group_kind: str = ""  # lowering kind for "fused" stages
+    out_fetches: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class _Optimized:
+    stages: List[_Stage]
+    fired: List[str]  # pass names that changed the plan
+    dropped_ops: int
+    dead_fetches: List[str]
+    pruned_source_cols: List[str]
+    fused_ops: int  # logical ops absorbed into fused stages
+    source_needed: Optional[List[str]]  # None = no pruning applied
+
+
+def _op_inputs(op: PlanOp) -> Set[str]:
+    return set((op.binding or {}).values())
+
+
+def _optimize(
+    src: TensorFrame, ops: List[PlanOp], demand: Set[str], cfg
+) -> _Optimized:
+    """Run the rewrite pipeline over the chain (pure: no execution, no
+    metrics — callers record what fired). ``demand`` is the set of
+    column names the consumer of the leaf actually reads."""
+    # -- pass: column pruning (liveness, leaf -> root) ----------------------
+    live_ops: List[PlanOp] = []
+    dead_fetches: List[str] = []
+    dropped = 0
+    needed = set(demand)
+    below: Dict[int, Set[str]] = {}  # id(op) -> demand below that op
+    for op in reversed(ops):
+        below[id(op)] = set(needed)
+        if op.kind == "select":
+            # a select's demand is exactly the sources of its demanded
+            # aliases; everything else stops here
+            needed = {
+                src_ for src_, dst in op.select_cols if dst in needed
+            }
+            live_ops.append(op)
+            continue
+        if op.kind == "filter_rows":
+            live_ops.append(op)
+            continue
+        live = needed & set(op.fetch_names)
+        if cfg.plan_prune_columns and not live:
+            dropped += 1
+            dead_fetches.extend(op.fetch_names)
+            continue  # dead op: none of its outputs are ever read
+        live_ops.append(op)
+        needed = (needed - set(op.fetch_names)) | _op_inputs(op)
+    live_ops.reverse()
+    source_needed = sorted(needed & set(src.schema.names))
+    pruned_source = (
+        sorted(set(src.schema.names) - set(source_needed))
+        if cfg.plan_prune_columns
+        else []
+    )
+    prune_fired = bool(dropped or pruned_source)
+
+    # -- pass: map fusion (maximal runs of map ops) -------------------------
+    stages: List[_Stage] = []
+    fused_ops = 0
+    i = 0
+    while i < len(live_ops):
+        op = live_ops[i]
+        if op.kind not in _MAP_KINDS or not cfg.plan_fuse_maps:
+            stages.append(
+                _Stage(
+                    kind="map" if op.kind in _MAP_KINDS else op.kind,
+                    ops=[op],
+                )
+            )
+            i += 1
+            continue
+        j = i
+        while j < len(live_ops) and live_ops[j].kind in _MAP_KINDS:
+            j += 1
+        group = live_ops[i:j]
+        if len(group) == 1:
+            stages.append(_Stage(kind="map", ops=group))
+        else:
+            gkind = (
+                "map_blocks"
+                if any(o.kind == "map_blocks" for o in group)
+                else "map_rows"
+            )
+            # the group's outputs: group fetches still demanded BELOW
+            # its last op (a fetch consumed only inside the group never
+            # materializes — XLA DCEs its buffer). Without pruning, every
+            # group fetch materializes, matching op-at-a-time carry.
+            last = group[-1]
+            out = set()
+            for o in group:
+                out |= set(o.fetch_names)
+            if cfg.plan_prune_columns:
+                out &= below[id(last)]
+            stages.append(
+                _Stage(
+                    kind="fused",
+                    ops=group,
+                    group_kind=gkind,
+                    out_fetches=tuple(sorted(out)),
+                )
+            )
+            fused_ops += len(group)
+        i = j
+    fired = []
+    if fused_ops:
+        fired.append("fuse_maps")
+    if prune_fired:
+        fired.append("prune_columns")
+    return _Optimized(
+        stages=stages,
+        fired=fired,
+        dropped_ops=dropped,
+        dead_fetches=sorted(dead_fetches),
+        pruned_source_cols=pruned_source,
+        fused_ops=fused_ops,
+        source_needed=source_needed if cfg.plan_prune_columns else None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# composite graph construction
+# ---------------------------------------------------------------------------
+
+
+def _const_name(idx: int, ph: str) -> str:
+    """Composite-level name for op ``idx``'s per-call constant ``ph``
+    (two ops may use the same constant placeholder name)."""
+    return f"__plan_c{idx}__{ph}"
+
+
+#: bound on the per-graph composite memos below (FIFO eviction) — the
+#: same discipline as ops.py's `_map_plan_cache`: a long-lived process
+#: exploring many distinct chains off one shared first graph must not
+#: accumulate composites (each closure pins its group's graphs) forever
+_COMPOSE_CACHE_MAX = 64
+
+
+def _compose_cache(host, attr: str) -> "OrderedDict":
+    from collections import OrderedDict
+
+    cache = getattr(host, attr, None)
+    if cache is None:
+        cache = OrderedDict()
+        setattr(host, attr, cache)
+    return cache
+
+
+def _cache_put(cache: "OrderedDict", key, value) -> None:
+    while len(cache) >= _COMPOSE_CACHE_MAX:
+        cache.popitem(last=False)
+    cache[key] = value
+
+
+def _group_parts(
+    group: List[PlanOp],
+    schema: FrameInfo,
+    block_shapes: bool,
+    extra_cols: Sequence[str] = (),
+):
+    """The shared composite-construction pieces for a group of map ops:
+    input placeholders (every source column some op — or ``extra_cols``
+    — binds that no earlier op produces), renamed per-call constant
+    specs, and the closure-safe ``steps`` tuples. ``steps`` deliberately
+    captures only (kind, graph, binding, fetches, const names) — never
+    the PlanOps, whose ``parent`` frames would otherwise be pinned by
+    the graph-attached memo holding the composite."""
+    from ..schema import Shape as _Shape
+    from ..schema import for_numpy_dtype
+
+    produced: Set[str] = set()
+    in_cols: List[str] = []
+    for op in group:
+        for col in op.binding.values():
+            if col not in produced and col not in in_cols:
+                in_cols.append(col)
+        produced |= set(op.fetch_names)
+    for col in extra_cols:
+        if col not in produced and col not in in_cols:
+            in_cols.append(col)
+    phs: List[TensorSpec] = []
+    for col in in_cols:
+        info = schema[col]
+        shape = (
+            info.block_shape.with_lead(Unknown)
+            if block_shapes
+            else info.cell_shape
+        )
+        phs.append(TensorSpec(col, info.scalar_type, shape))
+    const_specs: List[TensorSpec] = []
+    for idx, op in enumerate(group):
+        for ph, arr in (op.constants or {}).items():
+            const_specs.append(
+                TensorSpec(
+                    _const_name(idx, ph),
+                    for_numpy_dtype(arr.dtype),
+                    _Shape(arr.shape),
+                )
+            )
+    steps = [
+        (
+            op.kind, op.graph, dict(op.binding),
+            tuple(op.fetch_names), tuple(op.constants or ()),
+        )
+        for op in group
+    ]
+    return phs, const_specs, steps
+
+
+def _const_feed_for(group: List[PlanOp]) -> Dict[str, np.ndarray]:
+    return {
+        _const_name(idx, ph): arr
+        for idx, op in enumerate(group)
+        for ph, arr in (op.constants or {}).items()
+    }
+
+
+def _run_steps(steps, feed: Dict[str, Any], vmap_row_ops: bool):
+    """Trace the group's ops in order over ``feed``; returns the value
+    environment (inputs + every op's fetches)."""
+    import jax
+
+    env = dict(feed)
+    for idx, (kind, graph, binding, fetches, consts) in enumerate(steps):
+        sub = {ph: env[col] for ph, col in binding.items()}
+        for ph in consts:
+            sub[ph] = feed[_const_name(idx, ph)]
+        if vmap_row_ops and kind == "map_rows":
+            # lift the row program over the block's lead axis; per-row
+            # math (and therefore bytes) is unchanged
+            out = jax.vmap(graph.fn)(sub)
+        else:
+            out = graph.fn(sub)
+        for name in fetches:
+            env[name] = out[name]
+    return env
+
+
+def _composite_for(
+    stage: _Stage, schema: FrameInfo
+) -> Tuple[CapturedGraph, Dict[str, np.ndarray]]:
+    """Build (memoized) the fused CapturedGraph for a group of map ops.
+
+    Placeholders are named after the source columns they bind (plus
+    renamed per-call constants), so the engine's ordinary
+    ``validate_map_inputs`` binds them with no feed_dict. Row-map ops
+    inside a block-lowered group are lifted with ``jax.vmap``. The
+    composite is memoized on the first op's graph keyed by the group's
+    graph identities + output set, so repeated forces (and resumed
+    journal jobs) reuse one compiled program."""
+    group = stage.ops
+    gkind = stage.group_kind
+    out_fetches = stage.out_fetches
+    key = (
+        gkind,
+        tuple(id(o.graph) for o in group),
+        tuple(tuple(sorted(o.binding.items())) for o in group),
+        out_fetches,
+    )
+    cache = _compose_cache(group[0].graph, "_plan_fuse_cache")
+    composite = cache.get(key)
+    if composite is None:
+        phs, const_specs, steps = _group_parts(
+            group, schema, block_shapes=(gkind == "map_blocks")
+        )
+
+        def fused_fn(feed: Dict[str, Any]) -> Dict[str, Any]:
+            env = _run_steps(
+                steps, feed, vmap_row_ops=(gkind == "map_blocks")
+            )
+            return {name: env[name] for name in out_fetches}
+
+        composite = CapturedGraph(
+            fused_fn, phs + const_specs, list(out_fetches)
+        )
+        _cache_put(cache, key, composite)
+    else:
+        cache.move_to_end(key)
+    return composite, _const_feed_for(group)
+
+
+# ---------------------------------------------------------------------------
+# lowering / execution
+# ---------------------------------------------------------------------------
+
+
+def _ops_mod():
+    from . import ops as _ops
+
+    return _ops
+
+
+def _run_stage(stage: _Stage, cur: TensorFrame) -> TensorFrame:
+    ops_mod = _ops_mod()
+    if stage.kind == "select":
+        return cur.select(*stage.ops[0].select_cols)
+    if stage.kind == "filter_rows":
+        return cur.filter_rows(stage.ops[0].filter_mask)
+    if stage.kind == "map":
+        op = stage.ops[0]
+        if op.kind == "map_rows":
+            return ops_mod.map_rows(op.graph, cur, _plan=False).cache()
+        return ops_mod.map_blocks(
+            op.graph, cur, constants=op.constants, _plan=False
+        ).cache()
+    # fused group
+    if stage.group_kind == "map_blocks" and any(
+        o.kind == "map_rows" for o in stage.ops
+    ):
+        # a row map lowered blockwise needs dense inputs; if any source
+        # column feeding the group is ragged/binary, fall back to
+        # op-at-a-time for this group (byte-identical, just unfused)
+        for op in stage.ops:
+            for col in op.binding.values():
+                if col in cur.schema.names:
+                    cd = cur.column_data(col)
+                    if cd.dense is None:
+                        for op2 in stage.ops:
+                            cur = _run_stage(
+                                _Stage(kind="map", ops=[op2]), cur
+                            )
+                        return cur
+    composite, const_feed = _composite_for(stage, cur.schema)
+    if stage.group_kind == "map_rows":
+        return ops_mod.map_rows(composite, cur, _plan=False).cache()
+    return ops_mod.map_blocks(
+        composite, cur, constants=const_feed or None, _plan=False
+    ).cache()
+
+
+def _conform(frame: TensorFrame, result_info: FrameInfo) -> TensorFrame:
+    """Reorder a materialized frame's columns to the leaf's declared
+    schema (op-at-a-time nests fetches differently than one fused op;
+    the bytes are identical, only the declared order must match)."""
+    frame._force()
+    cols = {c.name: frame._columns[c.name] for c in result_info}
+    return TensorFrame(cols, result_info, offsets=frame._offsets)
+
+
+def _record_metrics(opt: _Optimized) -> None:
+    for p in opt.fired:
+        _m_passes.inc(**{"pass": p})
+    if opt.fused_ops:
+        _m_fused.inc(opt.fused_ops)
+    n_pruned = len(opt.dead_fetches) + len(opt.pruned_source_cols)
+    if n_pruned and "prune_columns" in opt.fired:
+        _m_pruned.inc(n_pruned)
+
+
+def _lower(
+    src: TensorFrame,
+    ops: List[PlanOp],
+    demand: Set[str],
+    leaf: PlanOp,
+    conform: bool = True,
+) -> TensorFrame:
+    cfg = _cfg()
+    if not cfg.plan_lazy_ops:
+        # a recorded chain forced AFTER the master switch went off (a
+        # select/filter node has no legacy thunk to fall back to):
+        # lower strictly op-at-a-time — no rewrites
+        cfg = dataclasses.replace(
+            cfg, plan_fuse_maps=False, plan_prune_columns=False
+        )
+    with _span("plan.optimize", ops=len(ops)) as sp:
+        opt = _optimize(src, ops, demand, cfg)
+        _record_metrics(opt)
+        if sp is not None:
+            sp.attrs["fired"] = ",".join(opt.fired) or "none"
+            sp.attrs["stages"] = len(opt.stages)
+    src._force()
+    cur = src
+    if opt.source_needed is not None and set(opt.source_needed) != set(
+        src.schema.names
+    ):
+        # project the source down to what the plan actually reads: the
+        # pruned columns are never bound, so they never cross the link,
+        # and post-ops (filter's take) never touch them either
+        keep = [c for c in src.schema.names if c in set(opt.source_needed)]
+        cur = src.select(*keep)
+    for stage in opt.stages:
+        cur = _run_stage(stage, cur)
+    cur._force()
+    if conform and leaf.kind in _MAP_KINDS:
+        return _conform(cur, leaf.result_info)
+    return cur
+
+
+def execute(
+    node: PlanOp, legacy_thunk: Optional[Callable[[], TensorFrame]]
+) -> TensorFrame:
+    """Force a planned leaf: collect its chain, optimize, lower. With
+    the plan layer disabled — or for a single map with nothing to
+    rewrite — the op's own legacy thunk runs instead (the byte-identity
+    reference path; zero behavior change vs the op-at-a-time engine)."""
+    src, ops = _chain(node)
+    if legacy_thunk is not None and (
+        not enabled() or (len(ops) == 1 and node.kind in _MAP_KINDS)
+    ):
+        return legacy_thunk()
+    if legacy_thunk is None and not ops:
+        raise RuntimeError("select/filter plan node lost its chain")
+    demand = {c.name for c in node.result_info}
+    return _lower(src, ops, demand, node)
+
+
+# ---------------------------------------------------------------------------
+# pruned materialization for eager consumers (aggregate, unhoisted reduce)
+# ---------------------------------------------------------------------------
+
+
+_pruned_view_lock = threading.Lock()
+
+
+def pruned_view(frame: TensorFrame, demand: Set[str]) -> TensorFrame:
+    """Materialize a planned lazy frame *for an eager consumer that only
+    reads ``demand``* — the chain executes with pruning driven by that
+    demand, and ``frame`` itself STAYS lazy (forcing it later yields its
+    full schema). Memoized per (demand, rewrite toggles) on the frame so
+    repeated aggregates over one lazy pipeline execute it once."""
+    node = _planned(frame)
+    if node is None or not enabled():
+        frame._force()
+        return frame
+    cfg = _cfg()
+    key = (
+        frozenset(demand),
+        cfg.plan_fuse_maps,
+        cfg.plan_prune_columns,
+    )
+    with _pruned_view_lock:
+        cache = getattr(frame, "_plan_pruned_views", None)
+        if cache is None:
+            cache = frame._plan_pruned_views = {}
+        hit = cache.get(key)
+    if hit is not None:
+        return hit
+    src, ops = _chain(node)
+    demand = set(demand) & {c.name for c in node.result_info}
+    out = _lower(src, ops, set(demand), leaf=node, conform=False)
+    # restrict to the demanded columns (pruned ones may be absent; the
+    # consumer only reads `demand` by contract)
+    present = [c for c in out.schema.names if c in demand]
+    if set(present) != set(out.schema.names):
+        out = out.select(*present)
+    with _pruned_view_lock:
+        cache[key] = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reduce_blocks terminal (reduction hoisting)
+# ---------------------------------------------------------------------------
+
+
+def _compose_reduce(
+    map_stage: _Stage,
+    gr: CapturedGraph,
+    r_binding: Dict[str, str],
+    schema: FrameInfo,
+) -> Tuple[CapturedGraph, Dict[str, np.ndarray]]:
+    """The hoisted partial program: per block, run the fused map body,
+    then the reduce body on the mapped block — one dispatch per
+    partition. Memoized like :func:`_composite_for` (on the reduce
+    graph, keyed by the map group + binding)."""
+    group = map_stage.ops
+    key = (
+        tuple(id(o.graph) for o in group),
+        tuple(tuple(sorted(o.binding.items())) for o in group),
+        tuple(sorted(r_binding.items())),
+    )
+    cache = _compose_cache(gr, "_plan_hoist_cache")
+    composite = cache.get(key)
+    if composite is None:
+        # the reduce's own bindings are inputs too: a reduce may name a
+        # source column the maps never touch
+        phs, const_specs, steps = _group_parts(
+            group, schema, block_shapes=True,
+            extra_cols=list(r_binding.values()),
+        )
+        r_bind = dict(r_binding)
+
+        def partial_fn(feed: Dict[str, Any]) -> Dict[str, Any]:
+            env = _run_steps(steps, feed, vmap_row_ops=True)
+            return gr.fn(
+                {f"{f}_input": env[col] for f, col in r_bind.items()}
+            )
+
+        composite = CapturedGraph(
+            partial_fn, phs + const_specs, list(gr.fetch_names)
+        )
+        _cache_put(cache, key, composite)
+    else:
+        cache.move_to_end(key)
+    return composite, _const_feed_for(group)
+
+
+def _lower_hoisted_reduce(
+    src: TensorFrame,
+    map_stage: _Stage,
+    gr: CapturedGraph,
+    r_binding: Dict[str, str],
+    ledger=None,
+):
+    """Execute the hoisted reduce: one fused partial program per
+    partition (retries / chaos / OOM halving intact), then the reduce
+    graph's own ``[2, ...]`` merge folds the partials — the exact merge
+    program the unfused path uses, so the fold is byte-identical.
+    ``ledger`` spools per-partition partials for journaled jobs.
+
+    This mirrors ``_reduce_blocks_impl``'s drive (grouped async dispatch
+    unjournaled, per-partition sync + spool journaled, OOM degrade to
+    halved spans merged through the reduce program) with the fused
+    partial program in place of the raw reduce — a semantics change to
+    either driver's retry/OOM/quarantine handling must be applied to
+    BOTH (the reduce impl carries the matching cross-reference)."""
+    import jax.numpy as jnp
+
+    from ..utils import is_oom, run_with_retries
+    from .ops import _block_feeder, _jitted
+
+    ops_mod = _ops_mod()
+    composite, const_feed = _compose_reduce(
+        map_stage, gr, r_binding, src.schema
+    )
+    jit_part = _jitted(composite)
+    merge_jit = None  # built lazily: a single partition never merges
+
+    def merge_two(a, b):
+        nonlocal merge_jit
+        if merge_jit is None:
+            merge_jit = _jitted(gr)
+        feed = {
+            f"{f}_input": jnp.stack([a[f], b[f]]) for f in gr.fetch_names
+        }
+        return merge_jit(feed)
+
+    feeders = {}
+    for col in composite.placeholders:
+        if col in const_feed:
+            continue
+        src.column_block(col, None)  # rejects ragged/binary
+        feeders[col], _ = _block_feeder(src.column_data(col))
+    bounds = src.partition_bounds()
+
+    def partial_for_span(lo: int, hi: int, what: str):
+        feed = {col: fd(lo, hi) for col, fd in feeders.items()}
+        feed.update(const_feed)
+
+        def dispatch():
+            import jax
+
+            from ..utils.chaos import site as _chaos_site
+
+            _chaos_site("engine.dispatch")
+            return jax.block_until_ready(jit_part(feed))
+
+        try:
+            return run_with_retries(dispatch, what=what)
+        except Exception as e:
+            if is_oom(e):
+                if hi - lo > 1:
+                    from ..utils.failures import record_oom_split
+
+                    record_oom_split("reduce_blocks")
+                    logger.warning(
+                        "hoisted reduce span of %d rows exhausted device "
+                        "memory; halving and merging the halves", hi - lo,
+                    )
+                    del feed
+                    mid = (lo + hi) // 2
+                    a = partial_for_span(lo, mid, what)
+                    b = partial_for_span(mid, hi, what)
+                    return merge_two(a, b)
+                from ..utils.failures import DeviceOOMError
+
+                raise DeviceOOMError(
+                    "hoisted reduce partial exhausted device memory even "
+                    "at a single row"
+                ) from e
+            raise
+
+    if ledger is not None:
+        # journaled: per-partition dispatch with a sync each — host
+        # partials must spool per block (failure isolation), exactly
+        # like `_reduce_blocks_impl`'s ledger branch
+        ledger.ensure_plan(
+            [{"rows": hi - lo, "lo": lo, "hi": hi} for lo, hi in bounds],
+            graph=composite, schema=src.schema, rows=src.num_rows,
+            extra={"plan": "hoisted_reduce"},
+        )
+        partials = []
+        for p, (lo, hi) in enumerate(bounds):
+            if hi == lo:
+                continue
+            what = f"reduce_blocks partition {p}"
+            st, arrs = ledger.lookup(p)
+            if st == "quarantined":
+                continue
+            if st == "done":
+                partials.append(arrs)
+                continue
+            res = ledger.run_block(
+                p,
+                lambda lo=lo, hi=hi, what=what: {
+                    f: np.asarray(v)
+                    for f, v in partial_for_span(lo, hi, what).items()
+                },
+                rows=hi - lo,
+            )
+            if res is not None:
+                partials.append(res)
+    else:
+        # unjournaled: dispatch every partition async, ONE sync for the
+        # group inside the retry window — the legacy reduce driver's
+        # contract (per-partition syncing costs one host round-trip per
+        # partition); an OOM inside the grouped dispatch falls back to
+        # the sequential halving path above
+        def feed_for(p):
+            lo, hi = bounds[p]
+            if hi == lo:
+                return None
+            f = {col: fd(lo, hi) for col, fd in feeders.items()}
+            f.update(const_feed)
+            return f
+
+        def all_partials():
+            import jax
+
+            from ..utils.chaos import site as _chaos_site
+
+            _chaos_site("engine.dispatch")
+            ps = [
+                jit_part(feed)
+                for feed in map(feed_for, range(len(bounds)))
+                if feed is not None
+            ]
+            return jax.block_until_ready(ps)
+
+        try:
+            partials = run_with_retries(
+                all_partials, what="reduce_blocks partials"
+            )
+        except Exception as e:
+            if not is_oom(e):
+                raise
+            logger.warning(
+                "hoisted reduce grouped dispatch exhausted device "
+                "memory; retrying per partition with OOM halving",
+            )
+            partials = [
+                partial_for_span(lo, hi, f"reduce_blocks partition {p}")
+                for p, (lo, hi) in enumerate(bounds)
+                if hi > lo
+            ]
+    if not partials:
+        if ledger is not None and ledger.quarantined_indices:
+            return None
+        raise ValueError("reduce_blocks on an empty frame")
+    ops_mod._m_blocks.inc(len(partials), op="reduce_blocks")
+    acc = partials[0]
+    for part in partials[1:]:
+        acc = merge_two(acc, part)
+    return ops_mod._unpack_reduce_result(acc, gr.fetch_names)
+
+
+def reduce_terminal(fetches, dframe: TensorFrame, ledger=None):
+    """Plan-aware ``reduce_blocks``. Returns ``(handled, result,
+    rows)``: ``handled=False`` means the chain did not qualify and the
+    caller should run the legacy path (which forces the frame — fused
+    maps still fire there, just without reduce-driven pruning).
+    ``rows`` is the logical row count reduced, for the op metrics —
+    computed without forcing the lazy leaf."""
+    node = _planned(dframe)
+    if node is None or not enabled():
+        return False, None, None
+    cfg = _cfg()
+    ops_mod = _ops_mod()
+    gr = ops_mod._as_graph(fetches, dframe, cell_inputs=False)
+    from .validation import validate_reduce_block_graph
+
+    r_binding = validate_reduce_block_graph(gr, dframe.schema)
+    ops_mod._ensure_precision(gr, dframe.schema)
+    src, ops = _chain(node)
+    demand = set(r_binding.values())
+    pure_maps = all(o.kind in _MAP_KINDS for o in ops)
+    if cfg.plan_hoist_reduce and pure_maps:
+        # optimize OUTSIDE any span: if the chain turns out not to be
+        # hoistable this attempt is discarded and pruned_view/_lower
+        # runs (and records, and emits the span for) the real
+        # optimization — a span here would double-report one rewrite
+        opt = _optimize(src, ops, demand, cfg)
+        # hoistable: the surviving map chain collapsed to ONE stage
+        # (one fused group, or a single map — fusion need not be on
+        # for a 1-map chain); the reduce folds into its epilogue
+        hoistable = len(opt.stages) == 1 and opt.stages[0].kind in (
+            "fused",
+            "map",
+        )
+        if hoistable:
+            with _span("plan.optimize", ops=len(ops) + 1) as sp:
+                stage = opt.stages[0]
+                opt.fired.append("hoist_reduce")
+                # absorbed ops = the maps in the hoisted program + the
+                # reduce itself (replaces the map-fusion count: one
+                # program now holds all of them)
+                opt.fused_ops = len(stage.ops) + 1
+                _record_metrics(opt)
+                if sp is not None:
+                    sp.attrs["fired"] = ",".join(opt.fired)
+                    sp.attrs["stages"] = 1
+            src._force()
+            # a reduce binding may name a source column the maps never
+            # touch — ragged sources can't feed a block program, and
+            # that is exactly what the legacy path would reject too
+            # (column_block raises inside _lower_hoisted_reduce)
+            out = _lower_hoisted_reduce(
+                src, stage, gr, r_binding, ledger=ledger
+            )
+            return True, out, src.num_rows
+    if ledger is not None:
+        # journaled reduce over an unhoistable chain: let the caller's
+        # legacy path force the frame and journal per partition
+        return False, None, None
+    # no hoist: materialize a demand-pruned view (fusion/pruning still
+    # apply) and run the ordinary eager reduce over it
+    view = pruned_view(dframe, demand)
+    return True, ops_mod._reduce_blocks_impl(fetches, view, None), view.num_rows
+
+
+# ---------------------------------------------------------------------------
+# journal integration: one fused plan = one canonical job
+# ---------------------------------------------------------------------------
+
+
+def lower_for_job(frame: TensorFrame):
+    """Lower a planned lazy frame into ``(op, fetches, data, constants,
+    post)`` for the durable-job layer: ``op``/``fetches``/``data``/
+    ``constants`` feed the ordinary journaled engine path (one composite
+    graph = one canonical manifest fingerprint, deterministic across
+    processes), and ``post(frame)`` applies any trailing ``select``/
+    ``filter_rows`` nodes to the assembled result (skipped, with a
+    warning, on quarantine-shortened partials whose row positions no
+    longer line up). Raises ``ValueError`` when ``frame`` is not a
+    pending planned pipeline."""
+    node = _planned(frame)
+    if node is None:
+        raise ValueError(
+            "run_job('pipeline', ...) needs a pending lazy planned frame "
+            "(a chain of map/select/filter ops that has not been forced); "
+            "got a concrete or non-planned frame"
+        )
+    src, ops = _chain(node)
+    map_ops = [o for o in ops if o.kind in _MAP_KINDS]
+    if not map_ops:
+        raise ValueError(
+            "a pipeline job needs at least one map op in the chain"
+        )
+    # post-ops may only TRAIL the maps: the journaled unit is the fused
+    # map program, and select/filter replay deterministically on top
+    seen_post = False
+    for o in ops:
+        if o.kind in _MAP_KINDS:
+            if seen_post:
+                raise ValueError(
+                    "pipeline jobs support select/filter only AFTER the "
+                    "map chain (a mid-chain projection changes the "
+                    "journaled program; force the frame instead)"
+                )
+        else:
+            seen_post = True
+    demand = {c.name for c in node.result_info}
+    cfg = _cfg()
+    opt = _optimize(src, map_ops, _demand_above_posts(ops, demand), cfg)
+    if len(opt.stages) != 1:
+        raise ValueError(
+            "pipeline jobs need the map chain to lower to one fused "
+            "program (enable Config.plan_fuse_maps)"
+        )
+    _record_metrics(opt)
+    stage = opt.stages[0]
+    if stage.kind == "map":
+        op = stage.ops[0]
+        fetches: Any = op.graph
+        kind = op.kind
+        consts = op.constants
+    else:
+        composite, const_feed = _composite_for(stage, src.schema)
+        fetches = composite
+        kind = stage.group_kind
+        consts = const_feed or None
+    post_ops = [o for o in ops if o.kind not in _MAP_KINDS]
+    leaf = node
+
+    n_rows_full = src.num_rows
+
+    def post(result: Optional[TensorFrame]) -> Optional[TensorFrame]:
+        if result is None:
+            return None
+        cur = result
+        if cur.num_rows != n_rows_full:
+            # quarantined blocks dropped rows from the partial result,
+            # so a recorded filter mask (and row-aligned conform) no
+            # longer lines up with the surviving rows — applying it
+            # would silently select the WRONG rows. Surface the partial
+            # result untouched; the quarantine records say what's
+            # missing, and a resume_job(retry_quarantined=True) after a
+            # fix yields the full, post-processed pipeline.
+            if post_ops:
+                logger.warning(
+                    "pipeline job: %d trailing select/filter node(s) "
+                    "NOT applied to a quarantine-shortened partial "
+                    "result (%d of %d rows survive); re-run after "
+                    "clearing the quarantine for the full pipeline",
+                    len(post_ops), cur.num_rows, n_rows_full,
+                )
+            return cur
+        for o in post_ops:
+            if o.kind == "select":
+                cur = cur.select(*o.select_cols)
+            else:
+                cur = cur.filter_rows(o.filter_mask)
+        if leaf.kind in _MAP_KINDS:
+            cur = _conform(cur, leaf.result_info)
+        return cur
+
+    return kind, fetches, src, consts, post
+
+
+def _demand_above_posts(ops: List[PlanOp], demand: Set[str]) -> Set[str]:
+    """Walk trailing select/filter nodes to translate leaf demand into
+    demand at the top post-op boundary (select renames)."""
+    needed = set(demand)
+    for o in reversed(ops):
+        if o.kind == "select":
+            needed = {s for s, d in o.select_cols if d in needed}
+        elif o.kind == "filter_rows":
+            continue
+        else:
+            break
+    return needed
+
+
+# ---------------------------------------------------------------------------
+# explain
+# ---------------------------------------------------------------------------
+
+
+def explain_plan(dframe: TensorFrame) -> Optional[str]:
+    """Render the logical plan of a pending planned frame: the recorded
+    nodes, which rewrite passes would fire, the pruned columns, and the
+    fused program count. Returns None for non-planned frames (the
+    caller falls back to schema-only output). Pure — no execution, no
+    metrics."""
+    node = _planned(dframe)
+    if node is None:
+        return None
+    src, ops = _chain(node)
+    cfg = _cfg()
+    demand = {c.name for c in node.result_info}
+    lines = ["== Logical plan =="]
+    srepr = (
+        f"source: {len(src.schema.names)} column(s) "
+        f"{src.schema.names}"
+        + (" [lazy]" if src.is_lazy else f" rows={src._num_rows}")
+    )
+    lines.append(srepr)
+    for i, op in enumerate(ops, 1):
+        if op.kind in _MAP_KINDS:
+            binds = ", ".join(
+                f"{ph}<-{col}" for ph, col in sorted(op.binding.items())
+            )
+            extra = " const" if op.constants else ""
+            lines.append(
+                f" {i}. {op.kind} fetches={sorted(op.fetch_names)} "
+                f"binds[{binds}]{extra}"
+            )
+        elif op.kind == "select":
+            proj = ", ".join(
+                s if s == d else f"{s} as {d}" for s, d in op.select_cols
+            )
+            lines.append(f" {i}. select [{proj}]")
+        else:
+            n_keep = int(np.count_nonzero(op.filter_mask))
+            lines.append(
+                f" {i}. filter_rows [{n_keep}/{len(op.filter_mask)} rows]"
+            )
+    if not cfg.plan_lazy_ops:
+        lines.append("== Optimized ==")
+        lines.append(" (plan layer disabled: Config.plan_lazy_ops=False;")
+        lines.append("  ops execute one at a time)")
+        return "\n".join(lines)
+    opt = _optimize(src, ops, demand, cfg)
+    lines.append("== Optimized ==")
+    lines.append(
+        " passes fired: " + (", ".join(opt.fired) if opt.fired else "none")
+    )
+    if opt.dropped_ops:
+        lines.append(
+            f" pruned ops: {opt.dropped_ops} "
+            f"(dead fetches: {opt.dead_fetches})"
+        )
+    if opt.pruned_source_cols:
+        lines.append(
+            f" pruned source columns (never uploaded): "
+            f"{opt.pruned_source_cols}"
+        )
+    programs = 0
+    for i, stage in enumerate(opt.stages, 1):
+        if stage.kind == "fused":
+            programs += 1
+            lines.append(
+                f" stage {i}: fused {stage.group_kind} "
+                f"[{len(stage.ops)} ops -> 1 program] "
+                f"fetches={list(stage.out_fetches)}"
+            )
+        elif stage.kind == "map":
+            programs += 1
+            op = stage.ops[0]
+            lines.append(
+                f" stage {i}: {op.kind} fetches={sorted(op.fetch_names)}"
+            )
+        elif stage.kind == "select":
+            proj = ", ".join(
+                s if s == d else f"{s} as {d}"
+                for s, d in stage.ops[0].select_cols
+            )
+            lines.append(f" stage {i}: select [{proj}]")
+        else:
+            lines.append(f" stage {i}: filter_rows")
+    lines.append(f" fused programs: {programs}")
+    return "\n".join(lines)

@@ -433,10 +433,25 @@ class TensorFrame:
 
     # -- relational-ish ops ------------------------------------------------
 
+    def _planned_lazy(self) -> bool:
+        """True when this frame is a pending logical-plan node: relational
+        ops on it record plan nodes instead of forcing (``engine/plan.py``
+        — ``select`` is what gives column pruning its demand signal)."""
+        if self._thunk is None or getattr(self, "_plan_node", None) is None:
+            return False
+        from ..engine import plan as _plan
+
+        return _plan.enabled()
+
     def select(self, *cols: Union[str, Tuple[str, str]]) -> "TensorFrame":
         """Project columns; a ``(src, alias)`` tuple renames — the analog of
         the reference's ``df.select(df.y, df.y.alias('z'))``
-        (``README.md:113``)."""
+        (``README.md:113``). On a pending planned frame the projection is
+        recorded lazily (it drives the pruning pass) instead of forcing."""
+        if self._planned_lazy():
+            from ..engine import plan as _plan
+
+            return _plan.record_select(self, cols)
         self._force()
         new_cols: Dict[str, _ColumnData] = {}
         new_infos: List[ColumnInfo] = []
@@ -496,6 +511,10 @@ class TensorFrame:
         return TensorFrame(cols, self._info)
 
     def filter_rows(self, mask: np.ndarray) -> "TensorFrame":
+        if self._planned_lazy():
+            from ..engine import plan as _plan
+
+            return _plan.record_filter(self, mask)
         self._force()
         idx = np.nonzero(np.asarray(mask))[0]
         cols = {n: cd.take(idx) for n, cd in self._columns.items()}
@@ -626,7 +645,11 @@ class TensorFrame:
         )
 
     def group_by(self, *keys: str) -> "GroupedFrame":
-        self._force()
+        # key validation needs only the (eagerly known) schema — a
+        # pending planned frame stays lazy so a following ``aggregate``
+        # can prune/fuse its chain (engine/plan.py)
+        if not self._planned_lazy():
+            self._force()
         for k in keys:
             if k not in self._info:
                 raise KeyError(f"group_by: no column {k!r}")

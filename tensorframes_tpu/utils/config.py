@@ -142,6 +142,26 @@ class Config:
     #: ``QuarantinedBlocksError`` at job end instead. Per-job override:
     #: ``run_job(..., strict=)``.
     quarantine_blocks: bool = True
+    #: master switch for the lazy logical-plan layer (``engine/plan.py``):
+    #: chained frame ops record plan nodes and are optimized once, then
+    #: lowered to the ordinary dispatch when a fetch forces them. False
+    #: restores strict op-at-a-time execution everywhere (the rewrite
+    #: passes below are then moot). See docs/pipelines.md.
+    plan_lazy_ops: bool = True
+    #: plan rewrite pass 1 — **map fusion**: a chain of ``map_rows`` /
+    #: ``map_blocks`` ops collapses into one jitted composite body, so N
+    #: chained maps cost one compiled program and one pass over the data.
+    plan_fuse_maps: bool = True
+    #: plan rewrite pass 2 — **column pruning**: ops none of whose fetches
+    #: are demanded downstream (by a ``select`` / ``reduce_blocks`` /
+    #: ``aggregate`` consumer) are dropped from the plan, so the source
+    #: columns only they bound never cross the host→device link.
+    plan_prune_columns: bool = True
+    #: plan rewrite pass 3 — **reduction hoisting**: a ``reduce_blocks``
+    #: over a pending map chain folds into the map program's per-block
+    #: epilogue — one program computes map outputs AND the block partial;
+    #: partials still merge through the reduce's own ``[2, ...]`` program.
+    plan_hoist_reduce: bool = True
 
 
 _lock = threading.Lock()
