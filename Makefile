@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-check bench-check-update bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -115,6 +115,18 @@ bench-ingest:
 # TFT_BENCH_PIPELINE_ROWS / _OPS shrink it for smoke runs)
 bench-pipeline:
 	$(PY) bench.py pipeline
+
+# the perf-regression gate: fresh smoke-sized `bench.py map_rows` +
+# `decode_serve` runs compared against BASELINE.json's bench_gate block
+# within tolerance (default 30%; TFT_BENCH_TOLERANCE_PCT overrides) —
+# non-zero exit on regression, so the bench trajectory is enforceable
+# instead of advisory. Re-record after a legitimate perf change with
+# bench-check-update (the diff then documents the move).
+bench-check:
+	$(PY) benchmarks/bench_check.py
+
+bench-check-update:
+	$(PY) benchmarks/bench_check.py --update
 
 # all BASELINE configs + extras
 bench-all:

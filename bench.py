@@ -602,10 +602,12 @@ def main_decode_serve():
 
 
 def _serve_obs_overhead(lm, plen, max_new, iters=3):
-    """tokens/s with the tracing layer live vs killed: best-of
-    ``iters`` interleaved runs of the concurrency-4 workload, one with
-    a JSONL span sink attached, one under ``observability=False`` (the
-    runtime equivalent of ``TFT_OBS=0``)."""
+    """tokens/s with the tracing layer live vs killed — plus the
+    time-series SAMPLER (ISSUE 12) running at a 0.25 s cadence vs
+    parked: best-of ``iters`` interleaved runs of the concurrency-4
+    workload. Both deltas share the ≤ 1% budget; the sampler leg is the
+    worst case for it (a registry walk every 250 ms against a tiny CPU
+    model — real-chip step times dwarf it)."""
     import os
     import shutil
     import tempfile
@@ -618,7 +620,8 @@ def _serve_obs_overhead(lm, plen, max_new, iters=3):
     # the axis FORCES each leg's state; the operator's own setting
     # (e.g. an outer TFT_OBS=0 smoke run) is restored afterwards
     prev_obs = get_config().observability
-    on = off = 0.0
+    prev_interval = get_config().obs_sample_interval_s
+    on = off = sampler_on = sampler_off = 0.0
     try:
         for i in range(iters):
             set_config(observability=True)
@@ -639,13 +642,43 @@ def _serve_obs_overhead(lm, plen, max_new, iters=3):
                     lm, 4, plen=plen, max_new=max_new, seed=8000 + i
                 )["tokens_per_sec"],
             )
+            # sampler pair: obs ON both legs, the background sampler the
+            # only difference (what the observatory itself costs)
+            set_config(
+                observability=True, obs_sample_interval_s=0.25
+            )
+            obs.timeseries.acquire_sampler()
+            try:
+                sampler_on = max(
+                    sampler_on,
+                    _serve_one_concurrency(
+                        lm, 4, plen=plen, max_new=max_new, seed=9000 + i
+                    )["tokens_per_sec"],
+                )
+            finally:
+                obs.timeseries.release_sampler()
+            sampler_off = max(
+                sampler_off,
+                _serve_one_concurrency(
+                    lm, 4, plen=plen, max_new=max_new, seed=9500 + i
+                )["tokens_per_sec"],
+            )
     finally:
-        set_config(observability=prev_obs)
+        set_config(
+            observability=prev_obs, obs_sample_interval_s=prev_interval
+        )
         shutil.rmtree(root, ignore_errors=True)
     return {
         "tracing_on_tokens_per_sec": round(on, 2),
         "obs_off_tokens_per_sec": round(off, 2),
         "overhead_pct": round((off - on) / off * 100.0, 2) if off else None,
+        "sampler_on_tokens_per_sec": round(sampler_on, 2),
+        "sampler_off_tokens_per_sec": round(sampler_off, 2),
+        "sampler_overhead_pct": (
+            round((sampler_off - sampler_on) / sampler_off * 100.0, 2)
+            if sampler_off
+            else None
+        ),
     }
 
 
@@ -987,7 +1020,13 @@ def main_map_rows_journal():
     from tensorframes_tpu.utils import get_config, set_config
 
     tft.enable_compilation_cache()
-    n_rows, width = 500_000, 256
+    import os as _os_rows
+
+    # TFT_BENCH_ROWS shrinks the workload for smoke runs and the
+    # bench-check regression gate (recorded next to the gate baseline,
+    # so the comparison replays the same size)
+    n_rows = int(_os_rows.environ.get("TFT_BENCH_ROWS", "") or 500_000)
+    width = 256
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n_rows, width)).astype(np.float32)
     df = tft.TensorFrame.from_columns({"features": x}).analyze()
@@ -1038,7 +1077,9 @@ def main_map_rows_journal():
     # the axis FORCES each leg's state; the operator's own setting
     # (e.g. an outer TFT_OBS=0 smoke run) is restored afterwards
     prev_obs = get_config().observability
+    prev_interval = get_config().obs_sample_interval_s
     dt_obs_on = dt_obs_off = float("inf")
+    dt_smp_on = dt_smp_off = float("inf")
     try:
         for i in range(iters):
             set_config(observability=True)
@@ -1049,9 +1090,22 @@ def main_map_rows_journal():
                 _obs.set_trace_sink(None)
             set_config(observability=False)
             dt_obs_off = min(dt_obs_off, one(False, 200 + i))
+            # sampler pair (ISSUE 12): obs ON both legs; the background
+            # time-series sampler at a 0.25 s cadence is the only
+            # difference — what the observatory itself costs (<= 1% bar)
+            set_config(observability=True, obs_sample_interval_s=0.25)
+            _obs.timeseries.acquire_sampler()
+            try:
+                dt_smp_on = min(dt_smp_on, one(False, 300 + i))
+            finally:
+                _obs.timeseries.release_sampler()
+            dt_smp_off = min(dt_smp_off, one(False, 400 + i))
     finally:
-        set_config(observability=prev_obs)
+        set_config(
+            observability=prev_obs, obs_sample_interval_s=prev_interval
+        )
     obs_overhead_pct = (dt_obs_on - dt_obs_off) / dt_obs_off * 100.0
+    sampler_overhead_pct = (dt_smp_on - dt_smp_off) / dt_smp_off * 100.0
     set_config(max_rows_per_device_call=old_chunk)
     workers_axis = _bench_job_workers(n_rows, width, job_root)
     shutil.rmtree(job_root, ignore_errors=True)
@@ -1080,6 +1134,15 @@ def main_map_rows_journal():
                             n_rows / dt_obs_off, 1
                         ),
                         "overhead_pct": round(obs_overhead_pct, 2),
+                        "sampler_on_rows_per_sec": round(
+                            n_rows / dt_smp_on, 1
+                        ),
+                        "sampler_off_rows_per_sec": round(
+                            n_rows / dt_smp_off, 1
+                        ),
+                        "sampler_overhead_pct": round(
+                            sampler_overhead_pct, 2
+                        ),
                     },
                     "seconds_per_job": {
                         "journal_off": round(dt_off, 4),

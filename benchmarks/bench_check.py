@@ -1,0 +1,184 @@
+"""Bench perf-regression gate (``make bench-check``).
+
+The r01→r05 BENCH files record the bench *trajectory*, but nothing has
+ever enforced it: a PR that quietly halved ``map_rows`` throughput
+would sail through tier-1 (correctness) and only show up rounds later
+when someone read the JSON. This gate makes the trajectory
+enforceable: it runs a fresh ``bench.py map_rows`` and
+``bench.py decode_serve`` under the PINNED environment recorded in
+``BASELINE.json["bench_gate"]`` (same workload shape as the baseline
+measurement — smoke-sized so the gate stays minutes, not tens of
+minutes), compares each headline metric against its recorded baseline,
+and exits non-zero when any falls more than ``tolerance_pct`` below
+it.
+
+Tolerance is deliberately generous (default 30%): these are wall-clock
+benches on shared hosts, and the gate exists to catch *structural*
+regressions (a lost fast path, an accidental sync, a double upload),
+not scheduler noise. Override per-run with ``TFT_BENCH_TOLERANCE_PCT``.
+
+Usage::
+
+    python benchmarks/bench_check.py            # check against baseline
+    python benchmarks/bench_check.py --update   # re-measure and record
+
+``--update`` reruns both benches and rewrites the ``bench_gate`` block
+(do this when a PR legitimately moves the numbers — the diff then
+documents the move).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(ROOT, "BASELINE.json")
+
+#: the gated bench configs: bench.py argv -> the headline JSON "metric"
+#: name recorded/compared (each bench prints exactly one JSON line)
+CONFIGS = (
+    ("map_rows", "map_rows_journaled_rows_per_sec"),
+    ("decode_serve", "decode_serve_tokens_per_sec"),
+)
+
+#: the pinned workload shape: smoke-sized axes so the whole gate runs
+#: in minutes; recorded alongside the numbers so check and baseline
+#: always measure the same thing
+GATE_ENV = {
+    "TFT_BENCH_ROWS": "120000",
+    "TFT_BENCH_JOB_WORKERS": "",  # skip the K-subprocess drain axis
+    "TFT_BENCH_REPLICAS": "1",
+    "TFT_BENCH_PROMPT_LENS": "32",
+    "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
+}
+
+DEFAULT_TOLERANCE_PCT = 30.0
+
+
+def _run_bench(config: str, env_overrides: dict) -> dict:
+    """Run one bench config and return its (last) JSON line."""
+    env = dict(os.environ)
+    for k, v in env_overrides.items():
+        if v == "" and not k.startswith("TFT_"):
+            continue  # unset non-TFT passthroughs (JAX_PLATFORMS) stay unset
+        # pinned-empty TFT_ vars are set to "" UNCONDITIONALLY: bench.py
+        # treats empty as "axis off", and on a clean environment the
+        # workers axis would otherwise run its 1/2/4-subprocess default
+        # inside the smoke-sized gate
+        env[k] = v
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), config],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout[-2000:] + proc.stderr[-4000:])
+        raise SystemExit(
+            f"bench.py {config} failed with rc={proc.returncode}"
+        )
+    last = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if "metric" in parsed:
+                last = parsed
+    if last is None:
+        sys.stderr.write(proc.stdout[-2000:])
+        raise SystemExit(f"bench.py {config} printed no JSON result line")
+    return last
+
+
+def _load_baseline() -> dict:
+    with open(BASELINE) as f:
+        return json.load(f)
+
+
+def update() -> int:
+    base = _load_baseline()
+    gate = {
+        "comment": (
+            "perf-regression gate for `make bench-check`: headline bench "
+            "values measured under `env`; a fresh run more than "
+            "`tolerance_pct` below any baseline fails the gate. "
+            "Re-record with `python benchmarks/bench_check.py --update`."
+        ),
+        "tolerance_pct": DEFAULT_TOLERANCE_PCT,
+        "env": {k: v for k, v in GATE_ENV.items() if k != "JAX_PLATFORMS"},
+        "metrics": {},
+    }
+    for config, metric in CONFIGS:
+        print(f"[bench-check] measuring {config} ...", flush=True)
+        result = _run_bench(config, GATE_ENV)
+        if result["metric"] != metric:
+            raise SystemExit(
+                f"bench.py {config} reported metric "
+                f"{result['metric']!r}; expected {metric!r}"
+            )
+        gate["metrics"][metric] = {
+            "value": result["value"],
+            "unit": result.get("unit", ""),
+            "config": config,
+        }
+        print(f"[bench-check]   {metric} = {result['value']}", flush=True)
+    base["bench_gate"] = gate
+    with open(BASELINE, "w") as f:
+        json.dump(base, f, indent=2)
+        f.write("\n")
+    print(f"[bench-check] baseline recorded in {BASELINE}")
+    return 0
+
+
+def check() -> int:
+    base = _load_baseline()
+    gate = base.get("bench_gate")
+    if not gate or not gate.get("metrics"):
+        sys.stderr.write(
+            "bench-check: no bench_gate block in BASELINE.json — record "
+            "one with `python benchmarks/bench_check.py --update`\n"
+        )
+        return 2
+    tol = float(
+        os.environ.get("TFT_BENCH_TOLERANCE_PCT", "")
+        or gate.get("tolerance_pct", DEFAULT_TOLERANCE_PCT)
+    )
+    env = dict(GATE_ENV)
+    env.update(gate.get("env", {}))
+    failures = []
+    for metric, entry in gate["metrics"].items():
+        config = entry["config"]
+        print(f"[bench-check] running {config} ...", flush=True)
+        result = _run_bench(config, env)
+        fresh, baseline = float(result["value"]), float(entry["value"])
+        floor = baseline * (1.0 - tol / 100.0)
+        delta_pct = (fresh - baseline) / baseline * 100.0
+        verdict = "ok" if fresh >= floor else "REGRESSION"
+        print(
+            f"[bench-check]   {metric}: fresh={fresh:.1f} "
+            f"baseline={baseline:.1f} ({delta_pct:+.1f}%, floor "
+            f"{floor:.1f} at -{tol:.0f}%) -> {verdict}",
+            flush=True,
+        )
+        if fresh < floor:
+            failures.append((metric, fresh, baseline, delta_pct))
+    if failures:
+        sys.stderr.write(
+            "bench-check FAILED: "
+            + "; ".join(
+                f"{m} {f:.1f} vs baseline {b:.1f} ({d:+.1f}%)"
+                for m, f, b, d in failures
+            )
+            + "\n"
+        )
+        return 1
+    print("[bench-check] all metrics within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(update() if "--update" in sys.argv[1:] else check())

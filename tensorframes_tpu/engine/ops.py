@@ -28,6 +28,7 @@ row count (``TrimmingOperationsSuite.scala:25-39``).
 from __future__ import annotations
 
 import inspect
+import threading
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -39,6 +40,7 @@ from ..frame import GroupedFrame, TensorFrame
 from ..frame import transfer as _transfer
 from ..frame.table import _build_column, _ColumnData
 from ..obs import span as _span
+from ..obs import programs as _programs
 from ..obs.metrics import counter as _counter
 from ..schema import ColumnInfo, FrameInfo, Shape, Unknown
 from ..utils import ensure_x64, get_logger
@@ -293,12 +295,44 @@ def _graph_from_callable(
     return g
 
 
+#: monotonically increasing program sequence — the cost-registry key
+#: component that keeps two graphs with identical labels distinct
+#: (id() can be recycled after GC; this cannot). Lock-guarded: two
+#: threads forcing ops concurrently must not mint one seq for two
+#: graphs and merge their cost records.
+_prog_seq = 0
+_prog_seq_lock = threading.Lock()
+
+
+def _program_key(g: CapturedGraph, variant: str) -> Tuple[str, str]:
+    """(key, name) for a graph's compiled program in the cost registry
+    (``obs/programs.py``). Fused plan composites carry a ``plan_label``
+    set by ``engine/plan.py``; plain graphs are named by their
+    fetches."""
+    global _prog_seq
+    with _prog_seq_lock:
+        seq = getattr(g, "_prog_seq", None)
+        if seq is None:
+            _prog_seq += 1
+            seq = g._prog_seq = _prog_seq
+    label = getattr(g, "plan_label", None)
+    if not label:
+        fetches = ",".join(list(getattr(g, "fetch_names", ())) or ["anon"])
+        label = f"engine:{fetches}"
+    if variant:
+        label = f"{label}:{variant}"
+    return f"g{seq}:{label}", label
+
+
 def _jitted(g: CapturedGraph):
     j = getattr(g, "_jit_cache", None)
     if j is None:
         import jax
 
-        j = jax.jit(g.fn)
+        key, name = _program_key(g, "")
+        j = _programs.instrument(
+            jax.jit(g.fn), key=key, name=name, kind="engine.block",
+        )
         g._jit_cache = j
         _m_jit_builds.inc()
     else:
@@ -311,12 +345,30 @@ def _jitted_vmap(g: CapturedGraph):
     if j is None:
         import jax
 
-        j = jax.jit(jax.vmap(g.fn))
+        key, name = _program_key(g, "vmap")
+        j = _programs.instrument(
+            jax.jit(jax.vmap(g.fn)), key=key, name=name, kind="engine.row",
+        )
         g._jit_vmap_cache = j
         _m_jit_builds.inc()
     else:
         _m_jit_reuse.inc()
     return j
+
+
+def _feeder_streams_host(cd) -> bool:
+    """Whether :func:`_block_feeder` would stream HOST slices for this
+    dense column (over the device-cache budget) — checkable WITHOUT
+    building the feeder, because building one for an in-budget column
+    starts its chunked device upload as a side effect."""
+    from ..frame.table import _is_device_array
+    from ..utils import get_config
+
+    dense = cd.dense
+    return (
+        not _is_device_array(dense)
+        and dense.nbytes > get_config().device_cache_bytes
+    )
 
 
 def _block_feeder(cd):
@@ -329,9 +381,11 @@ def _block_feeder(cd):
     the old whole-``device_put`` copy), else host slices streamed one
     block at a time so HBM stays bounded by a single block.
     Device-resident columns (results of a previous op) feed directly — no
-    transfer, no budget check."""
+    transfer, no budget check. NOTE: building the stream slicer STARTS
+    the column's upload — callers that may still bail out of their pass
+    must run every bail-out check first (``_feeder_streams_host`` covers
+    the budget check side-effect-free)."""
     from ..frame.table import _is_device_array
-    from ..utils import get_config
 
     def _slicer(arr):
         # a [0:n] slice of a device array is an eager on-device copy — for
@@ -343,7 +397,7 @@ def _block_feeder(cd):
     dense = cd.dense
     if _is_device_array(dense):
         return _slicer(dense), False
-    if dense.nbytes <= get_config().device_cache_bytes:
+    if not _feeder_streams_host(cd):
         return cd.device_stream().slice, False
     return (lambda lo, hi: dense[lo:hi]), True
 
@@ -1301,11 +1355,12 @@ def _map_rows_thunk(
             instead."""
             import jax
 
-            feeders = {}
-            for ph in binding:
-                feeders[ph], streams = _block_feeder(col_data[ph])
-                if streams:
-                    return None
+            # EVERY bail-out runs before any feeder is built: building a
+            # feeder for an in-budget host column STARTS its chunked
+            # device upload, and bailing afterwards hands the pass to
+            # run_chunk, which uploads the same bytes AGAIN per chunk —
+            # the ROADMAP item-2 double-upload bug (an un-analyzed
+            # frame's unknown out-spec dims always took that path).
             budget = get_config().device_cache_bytes
             est = 0
             for spec in out_specs.values():
@@ -1317,6 +1372,11 @@ def _map_rows_thunk(
                 ) * spec.scalar_type.np_dtype.itemsize * n
             if est > budget:
                 return None
+            if any(_feeder_streams_host(col_data[ph]) for ph in binding):
+                return None
+            feeders = {
+                ph: _block_feeder(col_data[ph])[0] for ph in binding
+            }
             # small rows dispatch in larger chunks: the row cap protects
             # activation memory for heavy per-row programs, but each
             # dispatch pays link latency — scale the chunk up until a
@@ -2562,19 +2622,30 @@ def analyze(dframe: TensorFrame) -> TensorFrame:
     return dframe.analyze()
 
 
-def explain(dframe: TensorFrame) -> str:
+def explain(dframe: TensorFrame, analyze: bool = False) -> str:
     """Detailed schema string (reference ``DebugRowOps.explain``,
     ``DebugRowOps.scala:528-545``) — and, for a pending planned frame,
     the logical plan first: recorded nodes, which rewrite passes fire,
     pruned columns, and the fused program count (``engine/plan.py``).
-    Pure: rendering the plan neither forces the frame nor executes it."""
+    Pure: rendering the plan neither forces the frame nor executes it.
+
+    ``analyze=True`` appends the per-program cost table from the
+    observatory's registry (``obs/programs.py``): every compiled
+    program this process has dispatched, with compile wall-time,
+    FLOP/byte estimates, invocation counts, cumulative dispatch time,
+    and roofline utilization — what a forced pipeline actually cost
+    (docs/observability.md)."""
     from . import plan as _plan_mod
 
     schema_txt = dframe.schema.explain()
     plan_txt = _plan_mod.explain_plan(dframe)
     if plan_txt is None:
-        return schema_txt
-    return f"{plan_txt}\n== Schema ==\n{schema_txt}"
+        out = schema_txt
+    else:
+        out = f"{plan_txt}\n== Schema ==\n{schema_txt}"
+    if analyze:
+        out = f"{out}\n{_programs.render_table()}"
+    return out
 
 
 def print_schema(dframe: TensorFrame) -> None:

@@ -532,6 +532,13 @@ def _composite_for(
         composite = CapturedGraph(
             fused_fn, phs + const_specs, list(out_fetches)
         )
+        #: the cost registry's display name (obs/programs.py): a fused
+        #: composite should read as the fusion it is, not as an
+        #: anonymous graph over its output columns
+        composite.plan_label = (
+            f"plan.fused:{gkind}[{len(group)} ops]:"
+            + ",".join(out_fetches)
+        )
         _cache_put(cache, key, composite)
     else:
         cache.move_to_end(key)
@@ -747,6 +754,10 @@ def _compose_reduce(
 
         composite = CapturedGraph(
             partial_fn, phs + const_specs, list(gr.fetch_names)
+        )
+        composite.plan_label = (
+            f"plan.hoisted_reduce[{len(group)} maps]:"
+            + ",".join(gr.fetch_names)
         )
         _cache_put(cache, key, composite)
     else:

@@ -89,6 +89,29 @@ _g_inflight = _gauge(
     "ingest.inflight_chunks",
     "Transfer chunks currently in flight (both directions)",
 )
+#: the live transfer knobs as gauges: a /varz reader (or the future
+#: autotuner) correlating a throughput dip with a retune needs the knob
+#: values IN the series, not in a config file somewhere else
+_g_chunk_bytes = _gauge(
+    "ingest.chunk_bytes",
+    "Configured transfer chunk size in bytes (<= 0 = monolithic)",
+)
+_g_streams = _gauge(
+    "ingest.streams", "Configured transfer pool width (chunks in flight)"
+)
+
+
+def _refresh_knob_gauges() -> None:
+    from ..utils.config import get_config
+
+    cfg = get_config()
+    _g_chunk_bytes.set(float(cfg.transfer_chunk_bytes))
+    _g_streams.set(float(max(1, int(cfg.transfer_streams))))
+
+
+from ..utils.config import register_on_change as _register_on_change  # noqa: E402
+
+_register_on_change(_refresh_knob_gauges)
 
 #: hard cap on chunks per transfer: a pathological chunk-bytes setting
 #: (1 byte) must not mint a million thread-pool tasks

@@ -85,7 +85,8 @@ __all__ = ["ScoringServer", "remote_arrow_mapper", "remote_map_in_arrow"]
 _m_requests = _counter(
     "serving.requests_total",
     "Connections served, by kind "
-    "(score|metrics|healthz|statusz|generate|http) and terminal status",
+    "(score|metrics|healthz|statusz|varz|generate|http) and terminal "
+    "status",
     labels=("kind", "status"),
 )
 _m_bytes_in = _counter(
@@ -213,6 +214,7 @@ class ScoringServer:
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
+        self._sampler_held = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -237,6 +239,13 @@ class ScoringServer:
             # is left under the caller's control)
             self._engine.start()
             self._engine_started_here = True
+        # a live server holds the time-series sampler, so /varz and the
+        # SLO monitors have history for exactly as long as traffic can
+        # reach them (refcounted; released in stop())
+        from ..obs import timeseries as _ts
+
+        _ts.acquire_sampler()
+        self._sampler_held = True
         self._port = s.getsockname()[1]
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
@@ -252,6 +261,11 @@ class ScoringServer:
 
     def stop(self) -> None:
         self._stopping.set()
+        if getattr(self, "_sampler_held", False):
+            from ..obs import timeseries as _ts
+
+            self._sampler_held = False
+            _ts.release_sampler()
         if self._engine_started_here:
             self._engine.stop()
             self._engine_started_here = False
@@ -305,6 +319,7 @@ class ScoringServer:
         "/metrics": ("GET",),
         "/healthz": ("GET",),
         "/statusz": ("GET",),
+        "/varz": ("GET",),
         "/generate": ("POST",),
     }
 
@@ -344,8 +359,13 @@ class ScoringServer:
           exposition format, so ``curl http://host:port/metrics`` (or an
           actual scrape job) works against a live server with no sidecar;
         - ``GET /healthz`` — liveness JSON (engine watchdog age, queue
-          depth, pages in use); 200 while healthy, 503 once the serving
-          supervisor marked the engine unhealthy or a stop wedged;
+          depth, pages in use, SLO state); 200 while healthy (the
+          ``status`` field says ``"degraded"`` under an SLO breach),
+          503 once the serving supervisor marked the engine unhealthy
+          or a stop wedged;
+        - ``GET /varz`` — the time-series store as JSON (sampled
+          gauges, counter rates, histogram quantiles; ``prefix=`` /
+          ``window=`` query params);
         - ``POST /generate`` (``engine=`` configured) — JSON
           ``{"prompt": [ids], "max_new_tokens": n, "temperature"?,
           "top_p"?, "seed"?, "deadline_s"?, "session"?}`` submitted to
@@ -372,7 +392,7 @@ class ScoringServer:
         line = head.split(b"\r\n", 1)[0].decode("latin-1", "replace")
         parts = line.split()
         verb = parts[0].upper() if parts else ""
-        path = (parts[1] if len(parts) > 1 else "/").split("?", 1)[0]
+        path, _, query = (parts[1] if len(parts) > 1 else "/").partition("?")
         headers: Dict[str, str] = {}
         for hline in head.split(b"\r\n")[1:]:
             name, _, val = hline.partition(b":")
@@ -400,7 +420,7 @@ class ScoringServer:
             # instead of falling through to an ambiguous catch-all
             out = (
                 b"endpoints: GET /metrics, GET /healthz, GET /statusz, "
-                b"POST /generate\n"
+                b"GET /varz, POST /generate\n"
             )
             status = "404 Not Found"
         elif verb not in allowed:
@@ -422,6 +442,10 @@ class ScoringServer:
         elif norm == "/statusz":
             kind = "statusz"
             status, out, extra_headers = self._handle_statusz()
+            ctype = "application/json; charset=utf-8"
+        elif norm == "/varz":
+            kind = "varz"
+            status, out, extra_headers = self._handle_varz(query)
             ctype = "application/json; charset=utf-8"
         else:  # /generate, POST
             kind = "generate"
@@ -479,6 +503,25 @@ class ScoringServer:
             report["debug_bundles"] = _flight.recent_bundles()
         except Exception:
             report["debug_bundles"] = []
+        # SLO state rides the health probe: "degraded" is a state
+        # DISTINCT from unhealthy — the engine still serves (stay 200,
+        # the balancer must not drain a whole fleet over a latency SLO)
+        # but it is violating its declared objectives, and the "status"
+        # field says so to anything that looks
+        degraded = False
+        try:
+            from ..obs import slo as _slo
+
+            mon = _slo.monitor()
+            report["slo"] = mon.status()
+            degraded = mon.degraded()
+        except Exception:
+            report["slo"] = []
+        report["status"] = (
+            "unhealthy"
+            if not report["healthy"]
+            else ("degraded" if degraded else "ok")
+        )
         body = json.dumps(report).encode("utf-8")
         if report["healthy"]:
             return "200 OK", body, {}
@@ -498,6 +541,14 @@ class ScoringServer:
         - ``debug_bundles``: recent flight-recorder bundles (path,
           reason, timestamp), newest first;
         - ``flight``: events currently held per ring;
+        - ``programs``: the per-program cost registry
+          (``obs/programs.py``) — every compiled program with compile
+          wall-time, FLOP/byte estimates, invocations, cumulative
+          dispatch time, and roofline utilization, heaviest first;
+        - ``slo``: every declared objective with its burn rates and
+          breach state (``obs/slo.py``);
+        - ``timeseries``: sampler state (running, interval, series
+          tracked — the full points are on ``GET /varz``);
         - ``chaos``: the active chaos spec ("" when clean — anything
           else taints every number on the page);
         - ``trace_sink``: whether a JSONL span sink is attached.
@@ -506,7 +557,11 @@ class ScoringServer:
         must not take the status page down with it)."""
         import json
 
+        from ..obs import programs as _programs
+        from ..obs import slo as _slo
+        from ..obs import timeseries as _ts
         from ..obs import trace_sink as _trace_sink
+        from ..utils.config import get_config
         from ..utils import chaos as _chaos_mod
 
         rings = _flight.rings()
@@ -519,12 +574,59 @@ class ScoringServer:
             "slowest_requests": slowest,
             "debug_bundles": _flight.recent_bundles(),
             "flight": {name: len(evts) for name, evts in rings.items()},
+            "programs": _programs.table(),
+            "slo": _slo.monitor().status(),
+            "timeseries": {
+                "sampler_running": _ts.sampler_running(),
+                "interval_s": get_config().obs_sample_interval_s,
+                "series": len(_ts.store().names()),
+            },
             "chaos": _chaos_mod.active_spec(),
             "trace_sink": _trace_sink() is not None,
         }
         return "200 OK", json.dumps(payload, default=str).encode(
             "utf-8"
         ), {}
+
+    @staticmethod
+    def _handle_varz(query: str = "") -> Tuple[str, bytes, Dict[str, str]]:
+        """``GET /varz`` — the time-series store as JSON: every sampled
+        series (gauges, counter ``.rate``\\ s, histogram ``.p50``/
+        ``.p99``/``.rate``) with its raw recent points and per-tier
+        depths, plus the sampler state. Query params: ``prefix=`` keeps
+        only series whose name starts with it; ``window=SECONDS``
+        returns the tier-merged trailing window instead of the raw
+        tier. Always 200 (an empty store renders as ``{}``: the sampler
+        simply has not run)."""
+        import json
+        from urllib.parse import parse_qs
+
+        from ..obs import timeseries as _ts
+        from ..utils.config import get_config
+
+        prefix: Optional[str] = None
+        window_s: Optional[float] = None
+        try:
+            q = parse_qs(query or "")
+            if q.get("prefix"):
+                prefix = q["prefix"][0]
+            if q.get("window"):
+                window_s = float(q["window"][0])
+        except (ValueError, TypeError):
+            return (
+                "400 Bad Request",
+                b'{"error": "bad query: expected prefix=NAME and/or '
+                b'window=SECONDS"}',
+                {},
+            )
+        payload = {
+            "sampler_running": _ts.sampler_running(),
+            "interval_s": get_config().obs_sample_interval_s,
+            "series": _ts.store().to_dict(
+                prefix=prefix, window_s=window_s
+            ),
+        }
+        return "200 OK", json.dumps(payload).encode("utf-8"), {}
 
     @staticmethod
     def _timing_payload(handle, total_s: float) -> Dict[str, Any]:
@@ -806,7 +908,7 @@ class ScoringServer:
                 # history from the 512-slot ring within the hour
                 ring = (
                     "probes"
-                    if kind in ("metrics", "healthz", "statusz")
+                    if kind in ("metrics", "healthz", "statusz", "varz")
                     else "serving"
                 )
                 _flight.record(

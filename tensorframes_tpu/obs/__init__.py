@@ -41,6 +41,9 @@ from .tracing import (
     use_trace,
 )
 from . import flight
+from . import programs
+from . import slo
+from . import timeseries
 
 __all__ = [
     "Counter",
@@ -67,4 +70,7 @@ __all__ = [
     "set_trace_sink",
     "trace_sink",
     "flight",
+    "programs",
+    "slo",
+    "timeseries",
 ]

@@ -49,6 +49,7 @@ __all__ = [
     "histogram",
     "snapshot",
     "render_prometheus",
+    "quantile_from_counts",
 ]
 
 #: environment kill switch, read once — flipping the env var mid-process is
@@ -277,19 +278,14 @@ class Histogram(_Metric):
         bound — an UNDERestimate there, which is the conservative
         direction for the latency-derived hints this feeds). ``None``
         when the series has no samples. Consumers: the serving 503
-        ``Retry-After`` estimate (``interop/serving.py``)."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"quantile q must be in [0, 1]; got {q}")
+        ``Retry-After`` estimate (``interop/serving.py``) and the
+        time-series sampler's per-tick p50/p99 (``obs/timeseries.py``)."""
         s = self.series(**labels)
-        if not s or not s["count"]:
+        if s is None:
+            if not 0.0 <= q <= 1.0:  # argument errors never go silent
+                raise ValueError(f"quantile q must be in [0, 1]; got {q}")
             return None
-        target = q * s["count"]
-        cum = 0
-        for bound, cnt in zip(self.bounds, s["counts"]):
-            cum += cnt
-            if cum >= target:
-                return bound
-        return self.bounds[-1]
+        return quantile_from_counts(self.bounds, s["counts"], s["count"], q)
 
     def _series(self):
         with self._lock:
@@ -301,6 +297,35 @@ class Histogram(_Metric):
     def _reset(self):
         with self._lock:
             self._values.clear()
+
+
+def quantile_from_counts(
+    bounds: Sequence[float],
+    counts: Sequence[int],
+    count: int,
+    q: float,
+) -> Optional[float]:
+    """The bucket-quantile rule shared by :meth:`Histogram.quantile` and
+    the time-series sampler (which works from ``_series()`` snapshots,
+    not live metrics): the smallest upper bound whose cumulative count
+    reaches ``max(q * count, 1)`` observations — the ``max(..., 1)``
+    keeps ``q = 0`` (and tiny ``q`` on small series) at the smallest
+    bucket that actually HOLDS an observation instead of the registry's
+    first bound, which may never have been observed. A series entirely
+    in the ``+Inf`` tail reports the top finite bound (a documented
+    underestimate — the conservative direction for latency hints).
+    ``None`` for an empty series."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile q must be in [0, 1]; got {q}")
+    if not count:
+        return None
+    target = max(q * count, 1)
+    cum = 0
+    for bound, cnt in zip(bounds, counts):
+        cum += cnt
+        if cum >= target:
+            return bound
+    return bounds[-1]
 
 
 def _label_str(names: Tuple[str, ...], key: Tuple[str, ...]) -> str:

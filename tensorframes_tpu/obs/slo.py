@@ -1,0 +1,327 @@
+"""SLO monitors: declarative objectives over the time-series store.
+
+Metrics say what IS; an SLO says what is ACCEPTABLE — and the gap
+between the two is what pages an operator and what an autoscaler acts
+on. An :class:`Objective` names one bound over one stored series
+(:mod:`.timeseries`):
+
+>>> from tensorframes_tpu.obs import slo
+>>> slo.monitor().add(slo.ttft_p99(0.5))           # TTFT p99 <= 500 ms
+>>> slo.monitor().add(slo.tokens_per_s_floor(200)) # emission floor
+>>> slo.monitor().add(slo.queue_depth_ceiling(32))
+>>> slo.monitor().add(slo.error_rate_ceiling(0.5)) # failed req/s
+
+Evaluation rides the sampler tick (``timeseries.sample_once``) and uses
+the standard two-window **burn-rate** shape: the *fast* window (default
+60 s) measures the fraction of recent samples violating the bound —
+responsive, catches a sharp breach within seconds — and the *slow*
+window (default 300 s) measures the same over a longer span, separating
+a blip from a sustained burn. An objective **breaches** when the fast
+window's violation fraction reaches ``burn_threshold`` (default 0.5)
+with at least ``min_samples`` points; while also past the threshold on
+the slow window the breach is ``severity="sustained"``, else
+``"fast"``.
+
+Breach/recovery transitions emit flight-recorder events (the ``slo``
+ring) and count into ``slo.breaches_total{slo}``; the live state is the
+``slo.breached{slo}`` gauge, the ``/statusz`` ``slo`` table, and the
+``/healthz`` ``status`` field — ``"degraded"`` (still HTTP 200: the
+replica serves, but it is violating its objectives) as a state DISTINCT
+from ``"unhealthy"`` (503: the engine cannot serve at all). Cookbook:
+``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import get_logger
+from . import flight as _flight
+from .metrics import counter as _counter, gauge as _gauge
+
+__all__ = [
+    "Objective",
+    "SLOMonitor",
+    "error_rate_ceiling",
+    "monitor",
+    "queue_depth_ceiling",
+    "tokens_per_s_floor",
+    "ttft_p99",
+]
+
+logger = get_logger("obs.slo")
+
+_m_breaches = _counter(
+    "slo.breaches_total",
+    "SLO breach transitions (ok -> breached), by objective",
+    labels=("slo",),
+)
+_g_breached = _gauge(
+    "slo.breached",
+    "Whether the objective is currently breached (1) or ok (0)",
+    labels=("slo",),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One declarative objective over one stored time series.
+
+    ``kind="upper"``: a sample violates when ``value > bound`` (latency
+    bounds, depth ceilings); ``kind="lower"``: when ``value < bound``
+    (throughput floors)."""
+
+    name: str
+    series: str
+    bound: float
+    kind: str = "upper"
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    burn_threshold: float = 0.5
+    min_samples: int = 3
+    #: treat exact-0.0 samples as "no traffic" and exclude them from
+    #: the burn computation. Counter-rate series record an explicit
+    #: 0.0 every tick while idle (by design — the autoscaler wants to
+    #: see idleness), so a throughput FLOOR over one would otherwise
+    #: breach on a healthy idle server. On by default for
+    #: :func:`tokens_per_s_floor`; a stalled-but-demanded server is the
+    #: queue-depth ceiling's job (the queue grows while the rate sits
+    #: at 0). Set False to alert on idleness itself.
+    ignore_zero: bool = False
+
+    def __post_init__(self):
+        if self.kind not in ("upper", "lower"):
+            raise ValueError(
+                f"objective kind must be 'upper' or 'lower'; got "
+                f"{self.kind!r}"
+            )
+        if not 0.0 < self.burn_threshold <= 1.0:
+            raise ValueError(
+                f"burn_threshold must be in (0, 1]; got "
+                f"{self.burn_threshold}"
+            )
+        if self.slow_window_s < self.fast_window_s:
+            raise ValueError(
+                "slow_window_s must be >= fast_window_s "
+                f"({self.slow_window_s} < {self.fast_window_s})"
+            )
+
+    def violates(self, value: float) -> bool:
+        return value > self.bound if self.kind == "upper" else (
+            value < self.bound
+        )
+
+
+class _State:
+    __slots__ = ("breached", "since", "severity", "fast_burn", "slow_burn",
+                 "last_value", "samples")
+
+    def __init__(self):
+        self.breached = False
+        self.since: Optional[float] = None
+        self.severity: Optional[str] = None
+        self.fast_burn = 0.0
+        self.slow_burn = 0.0
+        self.last_value: Optional[float] = None
+        self.samples = 0
+
+
+class SLOMonitor:
+    """Objective set + breach state machine, evaluated per sampler
+    tick. ``monitor()`` is the process-wide default the serving
+    endpoints read."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objectives: Dict[str, Objective] = {}
+        self._states: Dict[str, _State] = {}
+
+    def add(self, objective: Objective) -> Objective:
+        with self._lock:
+            self._objectives[objective.name] = objective
+            self._states.setdefault(objective.name, _State())
+        return objective
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._objectives.pop(name, None)
+            self._states.pop(name, None)
+        _g_breached.set(0.0, slo=name)
+
+    def clear(self) -> None:
+        with self._lock:
+            names = list(self._objectives)
+            self._objectives.clear()
+            self._states.clear()
+        for n in names:
+            _g_breached.set(0.0, slo=n)
+
+    def objectives(self) -> List[Objective]:
+        with self._lock:
+            return list(self._objectives.values())
+
+    # -- evaluation --------------------------------------------------------
+
+    @staticmethod
+    def _burn(obj: Objective, points) -> Optional[float]:
+        if not points:
+            return None
+        bad = sum(1 for _, v in points if obj.violates(v))
+        return bad / len(points)
+
+    def evaluate(self, store, now: Optional[float] = None) -> None:
+        """One pass over every objective against ``store``
+        (:class:`~tensorframes_tpu.obs.timeseries.TimeSeriesStore`);
+        called by ``timeseries.sample_once`` after the tick's points
+        land."""
+        ts = time.time() if now is None else now
+        for obj in self.objectives():
+            with self._lock:
+                st = self._states.get(obj.name)
+            if st is None:
+                continue
+            fast = store.window(obj.series, obj.fast_window_s, now=ts)
+            slow = store.window(obj.series, obj.slow_window_s, now=ts)
+            if obj.ignore_zero:
+                fast = [p for p in fast if p[1] != 0.0]
+                slow = [p for p in slow if p[1] != 0.0]
+            st.samples = len(fast)
+            st.last_value = fast[-1][1] if fast else None
+            fb = self._burn(obj, fast)
+            sb = self._burn(obj, slow)
+            st.fast_burn = 0.0 if fb is None else fb
+            st.slow_burn = 0.0 if sb is None else sb
+            breached = (
+                fb is not None
+                and len(fast) >= obj.min_samples
+                and fb >= obj.burn_threshold
+            )
+            severity = None
+            if breached:
+                severity = (
+                    "sustained"
+                    if sb is not None and sb >= obj.burn_threshold
+                    else "fast"
+                )
+            if breached and not st.breached:
+                st.breached = True
+                st.since = ts
+                _m_breaches.inc(slo=obj.name)
+                _g_breached.set(1.0, slo=obj.name)
+                logger.warning(
+                    "SLO %r breached (%s): %s %s %g, fast burn %.0f%% "
+                    "over %gs",
+                    obj.name, severity, obj.series,
+                    ">" if obj.kind == "upper" else "<",
+                    obj.bound, st.fast_burn * 100, obj.fast_window_s,
+                )
+                _flight.record(
+                    "slo", "breach",
+                    slo=obj.name, series=obj.series, bound=obj.bound,
+                    bound_kind=obj.kind, severity=severity,
+                    fast_burn=round(st.fast_burn, 4),
+                    slow_burn=round(st.slow_burn, 4),
+                    last_value=st.last_value,
+                )
+            elif st.breached and not breached:
+                st.breached = False
+                dur = ts - st.since if st.since is not None else None
+                st.since = None
+                _g_breached.set(0.0, slo=obj.name)
+                logger.warning(
+                    "SLO %r recovered (breached %.1fs)",
+                    obj.name, dur or 0.0,
+                )
+                _flight.record(
+                    "slo", "recovered",
+                    slo=obj.name, series=obj.series,
+                    breached_s=None if dur is None else round(dur, 3),
+                )
+            st.severity = severity
+
+    # -- reporting ---------------------------------------------------------
+
+    def status(self) -> List[Dict[str, Any]]:
+        """One row per objective — the ``/statusz`` ``slo`` table and
+        the ``/healthz`` ``slo`` payload."""
+        out = []
+        for obj in self.objectives():
+            with self._lock:
+                st = self._states.get(obj.name)
+            if st is None:
+                continue
+            out.append({
+                "name": obj.name,
+                "series": obj.series,
+                "bound": obj.bound,
+                "kind": obj.kind,
+                "breached": st.breached,
+                "severity": st.severity,
+                "since": st.since,
+                "fast_burn": round(st.fast_burn, 4),
+                "slow_burn": round(st.slow_burn, 4),
+                "last_value": st.last_value,
+                "window_samples": st.samples,
+            })
+        return out
+
+    def degraded(self) -> bool:
+        with self._lock:
+            return any(s.breached for s in self._states.values())
+
+    def reset(self) -> None:
+        self.clear()
+
+
+_monitor = SLOMonitor()
+
+
+def monitor() -> SLOMonitor:
+    """The process-wide default monitor (what ``/healthz`` degrades
+    on)."""
+    return _monitor
+
+
+# -- canned objectives (the serving four) ------------------------------------
+
+
+def ttft_p99(bound_s: float, **kw) -> Objective:
+    """Time-to-first-token p99 must stay at or under ``bound_s``."""
+    return Objective(
+        name="ttft_p99", series="serve.ttft_seconds.p99",
+        bound=float(bound_s), kind="upper", **kw,
+    )
+
+
+def tokens_per_s_floor(rate: float, **kw) -> Objective:
+    """Aggregate emission rate must stay at or above ``rate`` tok/s —
+    WHILE emitting: idle ticks (rate exactly 0) are excluded by default
+    (``ignore_zero=True``), so a server with no demand is not
+    "degraded"; pair with :func:`queue_depth_ceiling` to catch a server
+    that has demand but is not serving it."""
+    kw.setdefault("ignore_zero", True)
+    return Objective(
+        name="tokens_per_s", series="serve.tokens_total.rate",
+        bound=float(rate), kind="lower", **kw,
+    )
+
+
+def error_rate_ceiling(rate: float, **kw) -> Objective:
+    """Failed generation requests/second must stay at or under
+    ``rate``."""
+    return Objective(
+        name="error_rate",
+        series="serve.requests_total{status=failed}.rate",
+        bound=float(rate), kind="upper", **kw,
+    )
+
+
+def queue_depth_ceiling(depth: float, **kw) -> Objective:
+    """Admission-queue depth must stay at or under ``depth``."""
+    return Objective(
+        name="queue_depth", series="serve.queue_depth",
+        bound=float(depth), kind="upper", **kw,
+    )

@@ -1,0 +1,488 @@
+"""Time-series store: the registry's history, sampled on a cadence.
+
+The metrics registry (:mod:`.metrics`) is deliberately point-in-time —
+a scrape sees *now* and nothing else, so every question that needs a
+window ("what was queue depth over the last minute?", "did TTFT p99
+move when the fleet fenced r1?") has so far required an external
+Prometheus. The ROADMAP's autoscaler (item 5) and the SLO monitors
+(:mod:`.slo`) both need those windows **in-process**. This module is
+that store:
+
+- a background **sampler** (one daemon thread, cadence
+  ``Config.obs_sample_interval_s``, re-read each tick) walks the
+  default registry and appends one point per live series:
+
+  =========  ======================  =================================
+  metric     series name             point
+  =========  ======================  =================================
+  gauge      ``<name>{l=v}``         the gauge value
+  counter    ``<name>{l=v}.rate``    per-second rate since last tick
+  histogram  ``<name>.p50`` / ``.p99``  bucket quantiles of the
+                                     observations since the LAST tick
+                                     (windowed — a latency spike ages
+                                     out, so SLOs over these recover;
+                                     idle ticks record no point)
+  histogram  ``<name>.rate``         observations/second since last tick
+  =========  ======================  =================================
+
+- each series is a bounded **ring with downsampled retention tiers**:
+  tier 0 holds the newest ``samples_per_tier`` raw points; every
+  ``downsample`` tier-0 appends collapse (mean value, last timestamp)
+  into one tier-1 point, and so on — three tiers at the defaults
+  (512 samples, ×8) cover 512 s / ~68 min / ~9 h of history at a 1 s
+  cadence in ~12 KB per series;
+- queries merge tiers transparently: :meth:`TimeSeriesStore.window`
+  returns the best-resolution points covering the asked span;
+- ``GET /varz`` on the serving port (``interop/serving.py``) exports
+  the store as JSON, so operators and the autoscaler see real series
+  without running a Prometheus.
+
+The sampler also drives the two consumers that want a heartbeat: SLO
+evaluation (:mod:`.slo`) and the program-cost registry's JSONL
+persistence (:mod:`.programs`) ride the same tick, so one thread owns
+every periodic observability duty.
+
+Lifecycle is refcounted: every ``ScoringServer.start()`` acquires the
+sampler and ``stop()`` releases it; tests and benches call
+:func:`acquire_sampler` / :func:`release_sampler` directly (or
+:func:`sample_once` for deterministic single ticks). Kill-switch
+parity: with ``TFT_OBS=0`` / ``Config(observability=False)`` a tick
+records nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import get_logger
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    counter as _counter,
+    enabled,
+    gauge as _gauge,
+    quantile_from_counts,
+    registry,
+)
+
+__all__ = [
+    "TimeSeriesStore",
+    "acquire_sampler",
+    "release_sampler",
+    "sample_once",
+    "sampler_running",
+    "store",
+]
+
+logger = get_logger("obs.timeseries")
+
+_m_ticks = _counter(
+    "obs.ts_samples_total",
+    "Completed time-series sampler ticks (one registry walk each)",
+)
+_g_series = _gauge(
+    "obs.ts_series",
+    "Series currently tracked by the in-process time-series store",
+)
+
+#: histogram quantiles snapshotted per tick, as (suffix, q)
+_QUANTILES: Tuple[Tuple[str, float], ...] = (("p50", 0.5), ("p99", 0.99))
+
+#: a runaway label space (e.g. a per-request label someone adds later)
+#: must exhaust the store's series budget, not the process's memory
+_MAX_SERIES = 4096
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+class _Ring:
+    """One retention tier: a fixed-capacity ring of ``(ts, value)``."""
+
+    __slots__ = ("cap", "data", "start", "count")
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.data: List[Optional[Tuple[float, float]]] = [None] * cap
+        self.start = 0  # index of the OLDEST point
+        self.count = 0
+
+    def append(self, ts: float, value: float) -> None:
+        if self.count < self.cap:
+            self.data[(self.start + self.count) % self.cap] = (ts, value)
+            self.count += 1
+        else:  # wraparound: overwrite the oldest
+            self.data[self.start] = (ts, value)
+            self.start = (self.start + 1) % self.cap
+
+    def points(self) -> List[Tuple[float, float]]:
+        """Oldest-first copy."""
+        return [
+            self.data[(self.start + i) % self.cap]  # type: ignore[misc]
+            for i in range(self.count)
+        ]
+
+
+class _Series:
+    """One named series: tier 0 raw, higher tiers downsampled by
+    ``factor`` each — an append cascades a (mean, last-ts) point up one
+    tier every ``factor`` appends at the tier below."""
+
+    __slots__ = ("name", "tiers", "factor", "_acc_sum", "_acc_n")
+
+    def __init__(self, name: str, cap: int, factor: int, n_tiers: int):
+        self.name = name
+        self.tiers = [_Ring(cap) for _ in range(n_tiers)]
+        self.factor = factor
+        #: per-tier downsample accumulators (sum, n) feeding tier i+1
+        self._acc_sum = [0.0] * (n_tiers - 1)
+        self._acc_n = [0] * (n_tiers - 1)
+
+    def append(self, ts: float, value: float) -> None:
+        self.tiers[0].append(ts, value)
+        for t in range(len(self.tiers) - 1):
+            self._acc_sum[t] += value
+            self._acc_n[t] += 1
+            if self._acc_n[t] < self.factor:
+                break
+            value = self._acc_sum[t] / self._acc_n[t]
+            self._acc_sum[t] = 0.0
+            self._acc_n[t] = 0
+            self.tiers[t + 1].append(ts, value)
+
+
+class TimeSeriesStore:
+    """Bounded in-process history for every live registry series.
+
+    ``sample(now)`` is one tick (the background sampler calls it; tests
+    call it directly); ``window(name, seconds)`` / ``latest(name)`` are
+    the query surface the SLO monitors and ``/varz`` read."""
+
+    def __init__(
+        self,
+        samples_per_tier: Optional[int] = None,
+        downsample: Optional[int] = None,
+        tiers: int = 3,
+    ):
+        self._cap = samples_per_tier or _env_int("TFT_OBS_TS_SAMPLES", 512)
+        self._factor = downsample or _env_int("TFT_OBS_TS_DOWNSAMPLE", 8)
+        self._tiers = max(1, int(tiers))
+        self._series: Dict[str, _Series] = {}
+        self._lock = threading.Lock()
+        #: serializes whole ticks: the background sampler and an
+        #: explicit sample_once() caller must not interleave their
+        #: read-modify-writes of the rate/histogram baselines below (a
+        #: torn baseline records a spurious near-zero rate point, which
+        #: a floor-SLO would count as a violation)
+        self._sample_lock = threading.Lock()
+        #: counter/histogram-count snapshots from the previous tick, for
+        #: rate derivation: series name -> (ts, cumulative value)
+        self._last_cum: Dict[str, Tuple[float, float]] = {}
+        #: histogram bucket snapshots from the previous tick, for the
+        #: WINDOWED per-tick quantiles: series name -> (counts, count)
+        self._last_hist: Dict[str, Tuple[List[int], int]] = {}
+        self._dropped = False
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, name: str, ts: float, value: float) -> None:
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                if len(self._series) >= _MAX_SERIES:
+                    if not self._dropped:
+                        self._dropped = True
+                        logger.warning(
+                            "time-series store is full (%d series); new "
+                            "series are dropped — a label explosion "
+                            "upstream?", _MAX_SERIES,
+                        )
+                    return
+                s = self._series[name] = _Series(
+                    name, self._cap, self._factor, self._tiers
+                )
+            s.append(ts, float(value))
+
+    def _rate(self, name: str, ts: float, cum: float) -> None:
+        """Record a per-second rate point derived from a cumulative
+        value. The first sighting establishes the baseline (no point);
+        a counter reset (value went DOWN — process restart semantics)
+        re-baselines instead of recording a negative rate."""
+        prev = self._last_cum.get(name)
+        self._last_cum[name] = (ts, cum)
+        if prev is None:
+            return
+        pts, pv = prev
+        dt = ts - pts
+        if dt <= 0 or cum < pv:
+            return
+        self.record(name, ts, (cum - pv) / dt)
+
+    def sample(self, now: Optional[float] = None) -> int:
+        """One tick over the default registry; returns points recorded.
+        No-op (0) when observability is off."""
+        if not enabled():
+            return 0
+        with self._sample_lock:
+            return self._sample_locked(now)
+
+    def _sample_locked(self, now: Optional[float]) -> int:
+        ts = time.time() if now is None else now
+        reg = registry()
+        recorded = 0
+        for mname in reg.names():
+            try:
+                m = reg.get(mname)
+            except KeyError:
+                continue
+            series = m._series()
+            if isinstance(m, Gauge):
+                for key, v in series.items():
+                    self.record(_series_name(mname, m.label_names, key), ts, v)
+                    recorded += 1
+            elif isinstance(m, Counter):
+                for key, v in series.items():
+                    self._rate(
+                        _series_name(mname, m.label_names, key) + ".rate",
+                        ts, v,
+                    )
+                    recorded += 1
+            elif isinstance(m, Histogram):
+                for key, s in series.items():
+                    if not s["count"]:
+                        continue
+                    base = _series_name(mname, m.label_names, key)
+                    # quantiles over the DELTA since the last tick, not
+                    # the lifetime buckets: cumulative quantiles never
+                    # decay, so a one-minute latency spike would pin an
+                    # all-time p99 over any SLO bound for hours after
+                    # the incident ended. Windowed, the spike ages out
+                    # of the stored series with the spike itself (the
+                    # first sighting baselines; idle ticks record no
+                    # point; a reset re-baselines like counter rates).
+                    prev = self._last_hist.get(base)
+                    self._last_hist[base] = (
+                        list(s["counts"]), s["count"],
+                    )
+                    if prev is not None:
+                        pc, pn = prev
+                        dn = s["count"] - pn
+                        delta = [
+                            a - b for a, b in zip(s["counts"], pc)
+                        ]
+                        if dn > 0 and all(d >= 0 for d in delta):
+                            for suffix, q in _QUANTILES:
+                                qv = quantile_from_counts(
+                                    m.bounds, delta, dn, q
+                                )
+                                if qv is not None:
+                                    self.record(
+                                        f"{base}.{suffix}", ts, qv
+                                    )
+                                    recorded += 1
+                    self._rate(base + ".rate", ts, float(s["count"]))
+        _g_series.set(float(len(self._series)))
+        _m_ticks.inc()
+        return recorded
+
+    # -- querying ----------------------------------------------------------
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def points(self, name: str, tier: int = 0) -> List[Tuple[float, float]]:
+        """One tier's points for ``name``, oldest first ([] if absent)."""
+        with self._lock:
+            s = self._series.get(name)
+            if s is None or not 0 <= tier < len(s.tiers):
+                return []
+            return s.tiers[tier].points()
+
+    def window(
+        self, name: str, seconds: float, now: Optional[float] = None
+    ) -> List[Tuple[float, float]]:
+        """Points within the trailing ``seconds``, best resolution
+        first-served: tier 0 covers the newest span; where the window
+        reaches past tier 0's oldest point, older tiers fill in with
+        their downsampled points. Oldest first."""
+        ts_now = time.time() if now is None else now
+        lo = ts_now - seconds
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                return []
+            out: List[Tuple[float, float]] = []
+            covered_from = float("inf")  # walk tiers fine -> coarse
+            for ring in s.tiers:
+                pts = ring.points()
+                if pts:
+                    older = [
+                        p for p in pts if lo <= p[0] < covered_from
+                    ]
+                    out = older + out
+                    covered_from = min(covered_from, pts[0][0])
+                if covered_from <= lo:
+                    break
+        return out
+
+    def latest(self, name: str) -> Optional[Tuple[float, float]]:
+        pts = self.points(name, 0)
+        return pts[-1] if pts else None
+
+    def to_dict(
+        self,
+        prefix: Optional[str] = None,
+        window_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """The ``/varz`` payload: every (matching) series with its raw
+        tier-0 points (or a merged window when ``window_s`` is given)
+        and per-tier depths."""
+        names = [
+            n for n in self.names() if not prefix or n.startswith(prefix)
+        ]
+        out: Dict[str, Any] = {}
+        for n in names:
+            pts = (
+                self.window(n, window_s)
+                if window_s is not None
+                else self.points(n, 0)
+            )
+            with self._lock:
+                s = self._series.get(n)
+                depths = [r.count for r in s.tiers] if s is not None else []
+            out[n] = {
+                "points": [[round(ts, 3), v] for ts, v in pts],
+                "tiers": depths,
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._last_cum.clear()
+            self._last_hist.clear()
+            self._dropped = False
+
+
+_store = TimeSeriesStore()
+
+
+def store() -> TimeSeriesStore:
+    """The process-wide default store (what ``/varz`` and the SLO
+    monitors read)."""
+    return _store
+
+
+def sample_once(now: Optional[float] = None) -> int:
+    """One deterministic sampler tick against the default store,
+    including the piggybacked duties (SLO evaluation, program-registry
+    persistence) — what the background thread runs on its cadence."""
+    n = _store.sample(now)
+    try:
+        from . import slo as _slo
+
+        _slo.monitor().evaluate(_store, now=now)
+    except Exception:
+        logger.warning("SLO evaluation failed", exc_info=True)
+    try:
+        from . import programs as _programs
+
+        _programs.autopersist()
+    except Exception:
+        logger.warning("program-registry persistence failed", exc_info=True)
+    return n
+
+
+# -- background sampler ------------------------------------------------------
+
+_sampler_lock = threading.Lock()
+_sampler_refs = 0
+_sampler_thread: Optional[threading.Thread] = None
+#: the CURRENT thread's stop event — each started thread captures its
+#: own (a release->acquire bounce must not clear the event the old
+#: thread is waiting on, or the old thread never exits and two
+#: samplers tick concurrently)
+_sampler_stop = threading.Event()
+
+
+def _sampler_loop(stop_evt: threading.Event) -> None:
+    from ..utils.config import get_config
+
+    while not stop_evt.is_set():
+        interval = get_config().obs_sample_interval_s
+        if interval <= 0:
+            # parked: poll the knob at a slow fixed cadence
+            stop_evt.wait(0.5)
+            continue
+        t0 = time.monotonic()
+        try:
+            sample_once()
+        except Exception:
+            logger.warning("sampler tick failed", exc_info=True)
+        # fixed cadence, not fixed sleep: a slow tick does not stretch
+        # the series' spacing more than it must
+        stop_evt.wait(max(0.01, interval - (time.monotonic() - t0)))
+
+
+def acquire_sampler() -> None:
+    """Refcounted start of the background sampler thread. Every
+    ``acquire`` must be paired with a :func:`release_sampler`; the
+    thread exists while the count is positive. (``ScoringServer``
+    acquires on ``start()`` and releases on ``stop()``.)"""
+    global _sampler_refs, _sampler_thread, _sampler_stop
+    with _sampler_lock:
+        _sampler_refs += 1
+        if _sampler_thread is None or not _sampler_thread.is_alive():
+            stop_evt = threading.Event()
+            _sampler_stop = stop_evt
+            _sampler_thread = threading.Thread(
+                target=_sampler_loop, args=(stop_evt,),
+                name="tft-obs-sampler", daemon=True,
+            )
+            _sampler_thread.start()
+
+
+def release_sampler() -> None:
+    global _sampler_refs, _sampler_thread
+    with _sampler_lock:
+        if _sampler_refs == 0:
+            return
+        _sampler_refs -= 1
+        if _sampler_refs > 0:
+            return
+        _sampler_stop.set()
+        thread = _sampler_thread
+        _sampler_thread = None
+    if thread is not None:
+        thread.join(timeout=5)
+
+
+def sampler_running() -> bool:
+    with _sampler_lock:
+        t = _sampler_thread
+        return t is not None and t.is_alive()
+
+
+def _series_name(
+    metric: str, label_names: Sequence[str], key: Tuple[str, ...]
+) -> str:
+    """Stored-series name for one labeled metric series. The label part
+    delegates to the registry's own ``_label_str`` so snapshot keys and
+    stored-series names can never drift apart — the SLO presets (e.g.
+    ``serve.requests_total{status=failed}.rate``) match on this exact
+    format."""
+    from .metrics import _label_str
+
+    if not key:
+        return metric
+    return f"{metric}{{{_label_str(label_names, key)}}}"

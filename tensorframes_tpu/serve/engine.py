@@ -70,6 +70,7 @@ from ..models.transformer import (
 from ..obs import (
     current_trace as _current_trace,
     flight as _flight,
+    programs as _programs,
     span as _span,
     use_trace as _use_trace,
 )
@@ -170,6 +171,17 @@ _m_prefill_chunks = _counter(
 )
 
 
+_engine_seq_lock = threading.Lock()
+_engine_seq = 0
+
+
+def _next_engine_seq() -> int:
+    global _engine_seq
+    with _engine_seq_lock:
+        _engine_seq += 1
+        return _engine_seq
+
+
 class EngineUnhealthyError(RuntimeError):
     """The engine is shedding load: a terminal stepping failure (or a
     wedged stop) marked it unhealthy, and submissions fail fast until
@@ -239,6 +251,7 @@ class GenerationEngine:
         attention_impl: Optional[str] = None,
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache: Optional[bool] = None,
+        name: Optional[str] = None,
     ):
         import jax
 
@@ -305,21 +318,54 @@ class GenerationEngine:
         self._params_dev = jax.device_put(
             {k: v for k, v in params.items() if k != "n_heads"}
         )
+        #: display name for telemetry — the fleet passes its replica
+        #: names so the cost registry and /statusz attribute each step
+        #: program to its replica; the sequence keeps registry KEYS
+        #: unique even when two fleets reuse a replica name
+        seq = _next_engine_seq()
+        self.name = name if name is not None else f"eng{seq}"
         # donation halves pool traffic on real chips; CPU jax warns and
         # ignores it, so only request it where it works
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
-        self._prefill_jit = jax.jit(
-            self._prefill_impl(n_heads, moe_top_k), donate_argnums=donate
+        # each step program registers in the per-program cost registry
+        # (obs/programs.py): compile wall-time + FLOP/byte estimates at
+        # first dispatch, invocation count + cumulative dispatch time
+        # after. sync=True is semantics-neutral here — every dispatch
+        # site already block_until_ready()s inside its retry window, so
+        # the wrapper's sync just moves the wait inside the timing.
+        mmeta = dict(
+            max_slots=self.max_slots, page_size=self.page_size,
+            max_seq_len=self.max_seq_len, d_model=d_model,
+            attention_impl=self.attention_impl,
         )
-        self._decode_jit = jax.jit(
-            self._decode_impl(n_heads, moe_top_k), donate_argnums=donate
+        self._prefill_jit = _programs.instrument(
+            jax.jit(
+                self._prefill_impl(n_heads, moe_top_k),
+                donate_argnums=donate,
+            ),
+            key=f"serve.{seq}:prefill",
+            name=f"serve.prefill[{self.name}]",
+            kind="serve.step", sync=True, **mmeta,
+        )
+        self._decode_jit = _programs.instrument(
+            jax.jit(
+                self._decode_impl(n_heads, moe_top_k), donate_argnums=donate
+            ),
+            key=f"serve.{seq}:decode",
+            name=f"serve.decode[{self.name}]",
+            kind="serve.step", sync=True, **mmeta,
         )
         # built unconditionally (a jit wrapper is free until dispatched);
         # it only dispatches — and only then counts a program — when
         # chunked prefill or a prefix-cache resume needs it
-        self._prefill_chunk_jit = jax.jit(
-            self._prefill_chunk_impl(n_heads, moe_top_k),
-            donate_argnums=donate,
+        self._prefill_chunk_jit = _programs.instrument(
+            jax.jit(
+                self._prefill_chunk_impl(n_heads, moe_top_k),
+                donate_argnums=donate,
+            ),
+            key=f"serve.{seq}:prefill_chunk",
+            name=f"serve.prefill_chunk[{self.name}]",
+            kind="serve.step", sync=True, **mmeta,
         )
         #: distinct (name, abstract input signature) pairs dispatched —
         #: jit keys compiles on exactly this, so its length IS the number

@@ -297,8 +297,14 @@ class Fleet:
     ):
         if replicas < 1:
             raise ValueError(f"need replicas >= 1; got {replicas}")
+        # replica names flow into each engine so the per-program cost
+        # registry (obs/programs.py) and /statusz attribute every step
+        # program to its replica (serve.decode[r1], ...)
         self._replicas: List[_Replica] = [
-            _Replica(f"r{i}", GenerationEngine(model, **engine_kwargs))
+            _Replica(
+                f"r{i}",
+                GenerationEngine(model, name=f"r{i}", **engine_kwargs),
+            )
             for i in range(int(replicas))
         ]
         self.watchdog_interval_s = float(watchdog_interval_s)

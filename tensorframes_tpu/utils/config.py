@@ -71,6 +71,16 @@ class Config:
     #: span a no-op. ``TFT_OBS=0`` in the environment forces the same off
     #: state regardless of this field (read once at import).
     observability: bool = True
+    #: cadence of the time-series sampler (``obs/timeseries.py``): while
+    #: the sampler is running (a live ``ScoringServer`` holds it, or
+    #: ``obs.timeseries.acquire_sampler()``), every registered gauge,
+    #: counter-derived rate, and histogram p50/p99 is snapshotted into
+    #: the in-process ring-buffer store — and ``GET /varz`` / the SLO
+    #: monitors read from it — once per this many seconds. ``<= 0``
+    #: parks the sampler (the store only moves via explicit
+    #: ``sample_once()`` calls). Re-read every tick, so retunes apply
+    #: without a restart.
+    obs_sample_interval_s: float = 1.0
     #: how long synchronous consumers of a generation handle wait before
     #: declaring the stream lost: ``GenerationEngine.generate`` and the
     #: HTTP ``POST /generate`` endpoint both call

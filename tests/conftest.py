@@ -42,6 +42,17 @@ def _debug_bundles_in_tmp(tmp_path_factory):
     )
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _program_costs_in_tmp(tmp_path_factory):
+    """The program-cost registry's JSONL autopersist (obs/programs.py,
+    fed by the time-series sampler tick) writes to the test session's
+    tmp dir, not the developer's journal root."""
+    os.environ.setdefault(
+        "TFT_PROGRAM_COSTS_FILE",
+        str(tmp_path_factory.mktemp("program-costs") / "programs.jsonl"),
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

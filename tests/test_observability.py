@@ -140,6 +140,198 @@ class TestRegistry:
 
 
 # ---------------------------------------------------------------------------
+# histogram quantiles (the Retry-After + time-series sampler dependency)
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramQuantile:
+    def test_empty_histogram_returns_none(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t.q_empty_seconds", "x")
+        assert h.quantile(0.5) is None
+        assert h.quantile(0.99) is None
+        # labeled series that never observed: also None
+        hl = reg.histogram("t.q_lab_seconds", "x", labels=("op",))
+        assert hl.quantile(0.5, op="a") is None
+
+    def test_bad_q_raises_even_on_empty_series(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t.q_bad_seconds", "x")
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+        with pytest.raises(ValueError):
+            h.quantile(-0.1)
+        h.observe(1.0)
+        with pytest.raises(ValueError):
+            h.quantile(2.0)
+
+    def test_single_bucket_mass_every_q_reports_that_bound(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t.q_single_seconds", "x")
+        edge = h.bounds[5]
+        for _ in range(10):
+            h.observe(edge * 0.9)  # all land in bucket 5
+        for q in (0.0, 0.01, 0.5, 0.99, 1.0):
+            assert h.quantile(q) == edge
+
+    def test_all_mass_in_inf_tail_reports_top_bound(self):
+        """Observations beyond every finite bound land in +Inf; the
+        quantile reports the top FINITE bound — the documented
+        (conservative) underestimate, never None/inf."""
+        reg = MetricsRegistry()
+        h = reg.histogram("t.q_inf_seconds", "x")
+        for _ in range(4):
+            h.observe(1e15)
+        assert h.series()["counts"][-1] == 4  # really in the tail
+        for q in (0.5, 0.99, 1.0):
+            assert h.quantile(q) == h.bounds[-1]
+
+    def test_exact_bound_observation_is_le_inclusive(self):
+        """An observation exactly on a bound belongs to that bound's
+        bucket (Prometheus `le` semantics), so the quantile of a series
+        holding only exact-bound observations is that bound itself."""
+        reg = MetricsRegistry()
+        h = reg.histogram("t.q_exact_seconds", "x")
+        edge = h.bounds[7]
+        h.observe(edge)
+        assert h.quantile(0.5) == edge
+        assert h.quantile(1.0) == edge
+        # one just above tips the p100 into the NEXT bucket
+        h.observe(edge * 1.000001)
+        assert h.quantile(1.0) == h.bounds[8]
+        assert h.quantile(0.25) == edge
+
+    def test_q_zero_reports_smallest_occupied_bucket(self):
+        """q=0 must not report the registry's first bound when nothing
+        was ever observed there — it reports the smallest bucket that
+        HOLDS an observation (the max(target, 1) rule)."""
+        reg = MetricsRegistry()
+        h = reg.histogram("t.q_zero_seconds", "x")
+        edge = h.bounds[9]
+        h.observe(edge * 0.99)
+        assert h.quantile(0.0) == edge
+
+    def test_split_mass_interpolates_across_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t.q_split_seconds", "x")
+        lo, hi = h.bounds[3], h.bounds[10]
+        for _ in range(9):
+            h.observe(lo * 0.5)
+        h.observe(hi * 0.5)
+        assert h.quantile(0.5) == lo
+        assert h.quantile(0.95) == hi  # 0.95*10 = 9.5 -> needs the 10th
+
+
+# ---------------------------------------------------------------------------
+# Prometheus histogram exposition round-trip
+# ---------------------------------------------------------------------------
+
+
+def _parse_histogram_exposition(text, pname):
+    """Parse one histogram's series out of exposition text:
+    {label_str: {"buckets": [(le, cum)...], "sum": s, "count": n}}."""
+    import re
+
+    out = {}
+    pat = re.compile(
+        rf"^{re.escape(pname)}(_bucket|_sum|_count)(?:{{(.*)}})? (.+)$"
+    )
+    for line in text.splitlines():
+        m = pat.match(line)
+        if not m:
+            continue
+        suffix, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        le = None
+        rest = []
+        for part in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', labels):
+            if part[0] == "le":
+                le = part[1]
+            else:
+                rest.append(f"{part[0]}={part[1]}")
+        key = ",".join(rest)
+        series = out.setdefault(
+            key, {"buckets": [], "sum": None, "count": None}
+        )
+        if suffix == "_bucket":
+            series["buckets"].append(
+                (float("inf") if le == "+Inf" else float(le), int(value))
+            )
+        elif suffix == "_sum":
+            series["sum"] = float(value)
+        else:
+            series["count"] = int(value)
+    return out
+
+
+class TestPrometheusHistogramRoundTrip:
+    """The standard cumulative `_bucket`/`_sum`/`_count` exposition must
+    be parseable by a real Prometheus: le-labeled, float-parseable
+    bounds, monotone cumulative counts, an explicit +Inf bucket equal to
+    `_count`, and per-bucket counts reconstructible by differencing."""
+
+    def test_unlabeled_round_trip(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t.rt_seconds", "x")
+        observations = [2e-6, 1e-3, 1e-3, 0.5, 1e9]  # incl. +Inf tail
+        for v in observations:
+            h.observe(v)
+        parsed = _parse_histogram_exposition(
+            reg.render_prometheus(), "tft_t_rt_seconds"
+        )
+        s = parsed[""]
+        # every finite bound + the explicit +Inf bucket, in order
+        les = [le for le, _ in s["buckets"]]
+        assert les == sorted(les)
+        assert les[:-1] == [float(b) for b in h.bounds]
+        assert les[-1] == float("inf")
+        # cumulative counts are monotone; +Inf == _count == observations
+        cums = [c for _, c in s["buckets"]]
+        assert cums == sorted(cums)
+        assert cums[-1] == s["count"] == len(observations)
+        assert s["sum"] == pytest.approx(sum(observations))
+        # differencing reconstructs the internal per-bucket counts
+        per_bucket = [cums[0]] + [
+            b - a for a, b in zip(cums, cums[1:])
+        ]
+        assert per_bucket == h.series()["counts"]
+
+    def test_labeled_series_round_trip(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t.rt_lab_seconds", "x", labels=("op",))
+        h.observe(1e-3, op="a")
+        h.observe(2.0, op="a")
+        h.observe(5e-5, op="b")
+        parsed = _parse_histogram_exposition(
+            reg.render_prometheus(), "tft_t_rt_lab_seconds"
+        )
+        assert set(parsed) == {"op=a", "op=b"}
+        assert parsed["op=a"]["count"] == 2
+        assert parsed["op=b"]["count"] == 1
+        for s in parsed.values():
+            assert s["buckets"][-1][1] == s["count"]
+            assert s["buckets"][-1][0] == float("inf")
+
+    def test_scrape_quantile_matches_registry_quantile(self):
+        """A Grafana `histogram_quantile` built from the scraped buckets
+        must see the same bucket data `Histogram.quantile` uses: the
+        smallest le whose cumulative reaches q*count agrees with the
+        in-process answer."""
+        reg = MetricsRegistry()
+        h = reg.histogram("t.rt_q_seconds", "x")
+        for v in (1e-4, 2e-4, 5e-2, 1.0, 3.0):
+            h.observe(v)
+        parsed = _parse_histogram_exposition(
+            reg.render_prometheus(), "tft_t_rt_q_seconds"
+        )[""]
+        for q in (0.5, 0.99):
+            target = max(q * parsed["count"], 1)
+            from_scrape = next(
+                le for le, cum in parsed["buckets"] if cum >= target
+            )
+            assert from_scrape == pytest.approx(h.quantile(q))
+
+
+# ---------------------------------------------------------------------------
 # kill switch
 # ---------------------------------------------------------------------------
 

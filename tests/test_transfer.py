@@ -342,6 +342,33 @@ class TestEngineStreaming:
         )
         assert np.allclose(np.asarray(got), x.sum(axis=0), rtol=1e-5)
 
+    def test_unanalyzed_map_rows_uploads_bound_columns_once(self, rng):
+        """The ROADMAP item-2 double-upload regression (fixed in ISSUE
+        12): ``map_rows`` on an UN-analyzed frame has unknown out-spec
+        dims, so the device-resident fast path must bail — and it must
+        bail BEFORE probing ``_block_feeder``, which starts the
+        column's chunked upload. The old order started that upload,
+        bailed, and then the ``run_chunk`` fallback re-uploaded every
+        chunk via explicit h2d: the column crossed the link TWICE. The
+        exact-equality assert pins single-crossing."""
+        x = rng.normal(size=(50_000, 8)).astype(np.float32)
+        df = tft.TensorFrame.from_columns({"x": x})  # NOT analyzed
+        before = _counter("frame.h2d_bytes_total")
+        got = map_rows(lambda x: {"y": x + 1.0}, df).column_data("y")
+        assert np.array_equal(got.host(), x + 1.0)
+        assert _counter("frame.h2d_bytes_total") - before == x.nbytes
+
+    def test_analyzed_map_rows_also_uploads_once(self, rng):
+        """The fast path itself (analyzed frame, known out specs) has
+        always uploaded once via the streaming feeder; pin it so the
+        bail-out reorder cannot regress the happy path either."""
+        x = rng.normal(size=(50_000, 8)).astype(np.float32)
+        df = tft.TensorFrame.from_columns({"x": x}).analyze()
+        before = _counter("frame.h2d_bytes_total")
+        got = map_rows(lambda x: {"y": x + 1.0}, df).column_data("y")
+        assert np.array_equal(got.host(), x + 1.0)
+        assert _counter("frame.h2d_bytes_total") - before == x.nbytes
+
 
 class TestTelemetry:
     def test_histograms_and_gauge(self, tiny_chunks, rng):
