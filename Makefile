@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-check bench-check-update bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -77,6 +77,13 @@ test-obs:
 test-plan:
 	$(PY) -m pytest tests/ -q -m plan
 
+# the self-tuning suite (tensorframes_tpu/tune: store durability incl.
+# the 2-subprocess concurrent-write + kill -9 drills, learned-ranker
+# pruning, per-surface byte-identity vs TFT_TUNE=0, persistence
+# round-trip) — fast, CPU-only, deterministic; part of tier-1
+test-tune:
+	$(PY) -m pytest tests/ -q -m tune
+
 # just the real 2-process distributed suite
 test-multihost:
 	$(PY) -m pytest tests/test_multihost.py -q
@@ -115,6 +122,14 @@ bench-ingest:
 # TFT_BENCH_PIPELINE_ROWS / _OPS shrink it for smoke runs)
 bench-pipeline:
 	$(PY) bench.py pipeline
+
+# the self-tuning layer: cold-tune wall (trials included) vs
+# cached-tune wall (persisted winners, zero trials), plus
+# tuned-vs-static rows/s and tok/s on the map_rows / decode_serve
+# smoke shapes (one JSON line; TFT_BENCH_ROWS and
+# TFT_BENCH_TUNE_BUDGET_S shrink it)
+bench-autotune:
+	$(PY) bench.py autotune
 
 # the perf-regression gate: fresh smoke-sized `bench.py map_rows` +
 # `decode_serve` runs compared against BASELINE.json's bench_gate block

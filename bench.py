@@ -55,6 +55,13 @@ column bound only by a dead op must never cross the link). Also
 exactly one JSON line; ``TFT_BENCH_PIPELINE_ROWS`` / ``_OPS`` shrink
 it for smoke runs.
 
+``python bench.py autotune`` (``make bench-autotune``) benchmarks the
+self-tuning layer (``tensorframes_tpu/tune``): cold-tune wall (first
+online pass, micro-benchmark trials included) vs cached-tune wall (a
+fresh process resolving the persisted winners with zero trials), plus
+tuned-vs-static rows/s and tok/s on the map_rows and decode_serve
+smoke shapes. Also exactly one JSON line.
+
 ``python bench.py map_rows`` (``make bench-jobs``) benchmarks the
 durable batch-job layer and its distributed drain: journal on/off
 overhead, plus a K-subprocess workers axis (``TFT_BENCH_JOB_WORKERS``,
@@ -369,7 +376,8 @@ def _pct(xs, p):
 
 
 def _serve_one_concurrency(
-    lm, n_requests, plen, max_new, seed, prompts=None, **engine_kw
+    lm, n_requests, plen, max_new, seed, prompts=None, page_size=16,
+    **engine_kw,
 ):
     """One timed serving run: ``n_requests`` streams decoded through one
     shared continuous batch. Token timestamps are taken on the consumer
@@ -393,7 +401,7 @@ def _serve_one_concurrency(
     eng = GenerationEngine(
         lm,
         max_slots=n_requests,
-        page_size=16,
+        page_size=page_size,  # None = hint/tuned default (the autotune axis)
         max_seq_len=plen + max_new,
         queue_capacity=n_requests,
         **engine_kw,
@@ -1106,6 +1114,41 @@ def main_map_rows_journal():
         )
     obs_overhead_pct = (dt_obs_on - dt_obs_off) / dt_obs_off * 100.0
     sampler_overhead_pct = (dt_smp_on - dt_smp_off) / dt_smp_off * 100.0
+    # autotune axis (ISSUE 13): the same workload with the self-tuning
+    # layer OFF vs ONLINE against a throwaway store — the first on-pass
+    # pays the micro-benchmark trials (reported as its own wall), the
+    # steady-state passes run with the installed winner
+    from tensorframes_tpu import tune as _tune_mod
+
+    tune_store = _os.path.join(job_root, "tune.jsonl")
+    prev_tune = get_config()
+    dt_tune_on = dt_tune_off = float("inf")
+    try:
+        set_config(autotune=False)
+        for i in range(iters):
+            dt_tune_off = min(dt_tune_off, one(False, 500 + i))
+        set_config(
+            autotune=True, tune_mode="online", tune_file=tune_store
+        )
+        _tune_mod.reset()
+        t0 = time.perf_counter()
+        one(False, 600)  # the tuning pass: trials + first real run
+        tune_first_pass_s = time.perf_counter() - t0
+        for i in range(iters):
+            dt_tune_on = min(dt_tune_on, one(False, 601 + i))
+        tuned_winners = _tune_mod.snapshot()
+    finally:
+        set_config(
+            autotune=prev_tune.autotune, tune_mode=prev_tune.tune_mode,
+            tune_file=prev_tune.tune_file,
+        )
+        _tune_mod.reset()
+    autotune_axis = {
+        "off_rows_per_sec": round(n_rows / dt_tune_off, 1),
+        "on_rows_per_sec": round(n_rows / dt_tune_on, 1),
+        "tuning_first_pass_seconds": round(tune_first_pass_s, 4),
+        "winners": tuned_winners,
+    }
     set_config(max_rows_per_device_call=old_chunk)
     workers_axis = _bench_job_workers(n_rows, width, job_root)
     shutil.rmtree(job_root, ignore_errors=True)
@@ -1144,6 +1187,7 @@ def main_map_rows_journal():
                             sampler_overhead_pct, 2
                         ),
                     },
+                    "autotune": autotune_axis,
                     "seconds_per_job": {
                         "journal_off": round(dt_off, 4),
                         "journal_on": round(dt_on, 4),
@@ -1363,6 +1407,162 @@ def main_ingest():
     assert identical, "chunked transfer round-trip is not byte-identical"
 
 
+def main_autotune():
+    """The self-tuning layer's headline numbers (``make bench-autotune``,
+    ISSUE 13): against a throwaway store,
+
+    - **cold-tune wall**: the first ``map_rows`` pass in ``online``
+      mode — micro-benchmark trials included — vs the **cached-tune
+      wall**: the same pass after ``tune.reset()`` (a fresh process's
+      memo) resolving every winner from the persisted store with ZERO
+      trials. Cached ≪ cold is the persistence-round-trip acceptance
+      criterion, asserted via the tuner's own counters;
+    - **tuned-vs-static rows/s** on the map_rows smoke shape and
+      **tuned-vs-static tok/s** on the decode_serve smoke shape (static
+      = ``TFT_TUNE=0`` semantics; tuned = winners installed), plus the
+      serving-knob search wall (``tune.tune_serve_knobs``).
+
+    One JSON line. ``TFT_BENCH_ROWS`` shrinks the map_rows shape;
+    ``TFT_BENCH_TUNE_BUDGET_S`` bounds each signature's search."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu import tune
+    from tensorframes_tpu.engine import run_job
+    from tensorframes_tpu.models import TransformerLM
+    from tensorframes_tpu.obs import metrics as obs_metrics
+    from tensorframes_tpu.utils import get_config, set_config
+
+    tft.enable_compilation_cache()
+    tmp = tempfile.mkdtemp(prefix="tft-bench-autotune-")
+    store = os.path.join(tmp, "tune.jsonl")
+    n_rows = int(os.environ.get("TFT_BENCH_ROWS", "") or 200_000)
+    width = 256
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_rows, width)).astype(np.float32)
+    df = tft.TensorFrame.from_columns({"features": x}).analyze()
+
+    import jax.numpy as jnp
+
+    w1 = jnp.asarray(rng.normal(size=(width, width)).astype(np.float32))
+    w2 = jnp.asarray(rng.normal(size=(width,)).astype(np.float32))
+
+    def score(features):
+        return {"s": jnp.tanh(features @ w1) @ w2}
+
+    def one_map(i):
+        t0 = time.perf_counter()
+        res = run_job(
+            "map_rows", score, df, journal=False, job_dir=tmp,
+            job_id=f"bench-autotune-{i}",
+        )
+        assert res.completed.num_rows == n_rows
+        return time.perf_counter() - t0
+
+    def trials_total():
+        snap = obs_metrics.snapshot().get("tune.trials_total", {})
+        return float(sum((snap.get("values") or {}).values()))
+
+    prev = get_config()
+    budget = float(
+        os.environ.get("TFT_BENCH_TUNE_BUDGET_S", "") or 5.0
+    )
+    iters = 3
+    try:
+        set_config(
+            autotune=True, tune_mode="online", tune_file=store,
+            tune_budget_s=budget, max_rows_per_device_call=32768,
+        )
+        tune.reset()
+        # static leg (kill-switch semantics), warmed
+        set_config(autotune=False)
+        one_map(-1)
+        dt_static = min(one_map(i) for i in range(iters))
+        # cold tune: first online pass pays the trials
+        set_config(autotune=True)
+        t0 = time.perf_counter()
+        one_map(100)
+        cold_wall = time.perf_counter() - t0
+        trials_cold = trials_total()
+        # cached tune: a "fresh process" (memo dropped) resolves every
+        # winner from the persisted store — zero trials
+        tune.reset()
+        t0 = time.perf_counter()
+        one_map(101)
+        cached_wall = time.perf_counter() - t0
+        trials_cached = trials_total() - trials_cold
+        dt_tuned = min(one_map(200 + i) for i in range(iters))
+        map_winners = tune.snapshot()
+
+        # -- decode_serve smoke shape -----------------------------------
+        lm = TransformerLM.init(0, 256, d_model=32, n_heads=4, max_len=192)
+        plen, max_new, slots = 64, 32, 4
+        t0 = time.perf_counter()
+        serve_winners = tune.tune_serve_knobs(
+            lm, max_seq_len=plen + max_new, prompt_len=plen,
+            max_new_tokens=8, max_slots=slots, repeats=1,
+            budget_s=budget,
+        )
+        serve_tune_wall = time.perf_counter() - t0
+        set_config(autotune=False)
+        serve_static = _serve_one_concurrency(
+            lm, slots, plen, max_new, 0, page_size=None
+        )
+        set_config(autotune=True, tune_mode="cached")
+        tune.reset()
+        serve_tuned = _serve_one_concurrency(
+            lm, slots, plen, max_new, 0, page_size=None
+        )
+    finally:
+        set_config(
+            autotune=prev.autotune, tune_mode=prev.tune_mode,
+            tune_file=prev.tune_file, tune_budget_s=prev.tune_budget_s,
+            max_rows_per_device_call=prev.max_rows_per_device_call,
+        )
+        tune.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    print(
+        json.dumps(
+            {
+                "metric": "autotune_cached_tune_speedup",
+                "value": round(cold_wall / max(cached_wall, 1e-9), 2),
+                "unit": "x (cold-tune wall / cached-tune wall)",
+                "detail": {
+                    "device": str(jax.devices()[0]),
+                    "tune_budget_s": budget,
+                    "map_rows": {
+                        "rows": n_rows,
+                        "cold_tune_wall_s": round(cold_wall, 4),
+                        "cached_tune_wall_s": round(cached_wall, 4),
+                        "trials_cold": trials_cold,
+                        "trials_cached": trials_cached,
+                        "static_rows_per_sec": round(n_rows / dt_static, 1),
+                        "tuned_rows_per_sec": round(n_rows / dt_tuned, 1),
+                        "winners": map_winners,
+                    },
+                    "decode_serve": {
+                        "serve_knob_search_wall_s": round(
+                            serve_tune_wall, 3
+                        ),
+                        "static_tokens_per_sec": serve_static[
+                            "tokens_per_sec"
+                        ],
+                        "tuned_tokens_per_sec": serve_tuned[
+                            "tokens_per_sec"
+                        ],
+                        "winners": serve_winners,
+                    },
+                },
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
     import sys
 
@@ -1376,5 +1576,7 @@ if __name__ == "__main__":
         main_ingest()
     elif len(sys.argv) > 1 and sys.argv[1] == "pipeline":
         main_pipeline()
+    elif len(sys.argv) > 1 and sys.argv[1] == "autotune":
+        main_autotune()
     else:
         main()

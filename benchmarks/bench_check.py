@@ -52,6 +52,11 @@ GATE_ENV = {
     "TFT_BENCH_JOB_WORKERS": "",  # skip the K-subprocess drain axis
     "TFT_BENCH_REPLICAS": "1",
     "TFT_BENCH_PROMPT_LENS": "32",
+    # the autotuner kill switch, pinned OFF: tuning trials (and a
+    # winner that drifts between baseline recording and a later check)
+    # must not pollute the regression baseline — the gate measures the
+    # STATIC configuration, `make bench-autotune` measures tuning
+    "TFT_TUNE": "0",
     "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
 }
 

@@ -77,7 +77,7 @@ from .capture import (
     functions,
 )
 from .builder import OpBuilder
-from . import obs, schema, utils
+from . import obs, schema, tune, utils
 
 __all__ = [
     # the reference's nine public functions (core.py:11-12)
@@ -127,6 +127,7 @@ __all__ = [
     "OpBuilder",
     "obs",
     "schema",
+    "tune",
     "utils",
     # errors
     "InputNotFoundError",

@@ -1342,6 +1342,107 @@ def _map_rows_thunk(
                     for j, i in enumerate(sub):
                         out_cells[name][i] = arr[j]
 
+        def _tuned_chunk(static_rows: int) -> int:
+            """The block-row budget through the autotuner
+            (``tensorframes_tpu.tune``, surface ``map_rows.block_rows``,
+            keyed by per-row input bytes): Config's
+            ``max_rows_per_device_call`` is the seed default; an online
+            trial dispatches the REAL row program over a discarded
+            sample at each candidate chunking (user-shaped, retryable,
+            injectable at ``tune.trial`` like every other dispatch), so
+            the winner reflects this op's actual dispatch-overhead/
+            activation trade. Rows are independent and the halving
+            recursion preserves row order, so every candidate is
+            byte-identical to the default — the tuning contract."""
+            from .. import tune
+
+            if tune.mode() == "off":
+                return static_rows
+            if ledger is not None and not dense_fast:
+                # bucketed (ragged) journal plans re-derive from the
+                # live chunk on resume (no contiguous manifest rebuild),
+                # so a tuned winner landing in the shared store between
+                # a run and its resume would change the plan and fail
+                # ensure_plan — ragged journaled jobs stay config-driven
+                return static_rows
+            per_row = 0
+            for cd in col_data.values():
+                if cd.dense is not None:
+                    per_row += int(
+                        np.prod(cd.dense.shape[1:], initial=1)
+                    ) * cd.dense.dtype.itemsize
+                elif cd.cells is not None and len(cd.cells):
+                    c0 = np.asarray(cd.cells[0])
+                    per_row += int(
+                        np.prod(c0.shape, initial=1)
+                    ) * c0.dtype.itemsize
+            rb_bucket = 1 << max(2, int(max(per_row, 1) - 1).bit_length())
+            # frame size is PART of the signature: candidates and trials
+            # are n-dependent (a small frame cannot exercise a large
+            # budget), so a winner measured at one scale must never
+            # serve a job orders of magnitude bigger
+            n_bucket = 1 << max(2, int(max(n, 1) - 1).bit_length())
+            sig = (
+                f"row_bytes={rb_bucket}|cols={len(col_data)}|n={n_bucket}"
+            )
+            default = {"rows": int(static_rows)}
+            if dense_fast and n > 1:
+                # the sample is the fixed workload every candidate
+                # chunks; candidates past it would all measure as one
+                # dispatch of `sample` rows — indistinguishable — so
+                # only offer what the trial can genuinely compare. Two
+                # candidates (one down, one up) + the default keep the
+                # grid at 3, which the search measures IN FULL — with
+                # only a dispatch-count ranking, a larger grid's
+                # top-K halving would make the smaller-chunk side
+                # structurally unreachable
+                sample = int(min(n, static_rows * 2))
+                cands = sorted(
+                    {max(1, static_rows // 2), static_rows * 2}
+                )
+                grid = [
+                    {"rows": int(c)}
+                    for c in cands
+                    if c != static_rows and 1 <= c <= sample
+                ]
+
+                def discard(name, arr):
+                    pass
+
+                def trial(cand):
+                    rows = max(1, int(cand["rows"]))
+                    lo = 0
+                    while lo < sample:
+                        hi = min(lo + rows, sample)
+                        run_chunk(list(range(lo, hi)), sink=discard)
+                        lo = hi
+
+                def feats(cand):
+                    rows = max(1, int(cand["rows"]))
+                    dispatches = -(-sample // rows)
+                    nbytes = float(sample * max(per_row, 1))
+                    return 0.0, nbytes, float(dispatches)
+
+            else:
+                # ragged frames have no single contiguous bucket to
+                # sample; they resolve cached-only (a winner tuned on a
+                # matching dense signature still serves)
+                grid, feats, trial = [], None, None
+            try:
+                win = tune.lookup(
+                    "map_rows.block_rows", sig, default,
+                    grid=grid, feats=feats, trial=trial,
+                )
+                return max(1, int(win.get("rows", static_rows)))
+            except Exception:
+                logger.warning(
+                    "block-row tuning lookup failed; using "
+                    "max_rows_per_device_call", exc_info=True,
+                )
+                return static_rows
+
+        chunk = _tuned_chunk(chunk)
+
         def run_dense_fast() -> Optional[Dict[str, _ColumnData]]:
             """Device-resident execution for the all-dense single bucket:
             columns feed from memoized device copies (``_block_feeder``),
@@ -2634,7 +2735,8 @@ def explain(dframe: TensorFrame, analyze: bool = False) -> str:
     program this process has dispatched, with compile wall-time,
     FLOP/byte estimates, invocation counts, cumulative dispatch time,
     and roofline utilization — what a forced pipeline actually cost
-    (docs/observability.md)."""
+    (docs/observability.md) — followed by the autotuner's installed
+    tuned configs (``tensorframes_tpu.tune``; docs/tuning.md)."""
     from . import plan as _plan_mod
 
     schema_txt = dframe.schema.explain()
@@ -2645,6 +2747,9 @@ def explain(dframe: TensorFrame, analyze: bool = False) -> str:
         out = f"{plan_txt}\n== Schema ==\n{schema_txt}"
     if analyze:
         out = f"{out}\n{_programs.render_table()}"
+        from .. import tune as _tune
+
+        out = f"{out}\n{_tune.render_table()}"
     return out
 
 

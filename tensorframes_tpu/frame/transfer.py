@@ -119,6 +119,129 @@ _MAX_CHUNKS = 1024
 
 
 # ---------------------------------------------------------------------------
+# tuned link knobs
+# ---------------------------------------------------------------------------
+
+#: cap on the autotuner's trial payload for this link (bytes;
+#: ``TFT_TUNE_TRIAL_BYTES`` overrides — tests shrink it, operators on a
+#: fast link may grow it for higher-fidelity trials)
+_TRIAL_BYTES_DEFAULT = 64 << 20
+
+
+def _link_knobs() -> Tuple[int, int]:
+    """The effective ``(chunk_bytes, streams)`` for this link: the
+    Config statics, overridden by the autotuner's winner for the
+    ``transfer.link`` surface when one is installed (the per-pool-retune
+    re-read the r05 link-weather sensitivity asked for — winners key on
+    device kind, and ``tune.mode()`` gates everything). Chunking
+    disabled by config (``transfer_chunk_bytes <= 0``) is an operator
+    opt-out the tuner respects."""
+    from ..utils import get_config
+
+    cfg = get_config()
+    default_cb = int(cfg.transfer_chunk_bytes)
+    default_st = max(1, int(cfg.transfer_streams))
+    if default_cb <= 0:
+        return default_cb, default_st
+    try:
+        from .. import tune
+
+        if tune.mode() == "off":
+            return default_cb, default_st
+        grid, feats, trial = _link_search(default_cb, default_st)
+        win = tune.lookup(
+            "transfer.link", "link",
+            {"chunk_bytes": default_cb, "streams": default_st},
+            grid=grid, feats=feats, trial=trial,
+        )
+        cb = int(win.get("chunk_bytes", default_cb))
+        st = int(win.get("streams", default_st))
+        return (cb if cb > 0 else default_cb), max(1, min(st, 64))
+    except Exception:
+        logger.warning(
+            "transfer knob tuning lookup failed; using Config statics",
+            exc_info=True,
+        )
+        return default_cb, default_st
+
+
+def _link_search(default_cb: int, default_st: int):
+    """(grid, feats, trial) for the transfer-knob search. The trial
+    moves a seeded payload host→device as concurrent row chunks on a
+    PRIVATE pool (raw ``device_put`` — no recursion into this layer,
+    and the re-entrancy guard covers stray lookups). Payload is capped
+    (``TFT_TUNE_TRIAL_BYTES``), and chunk candidates are capped at half
+    the payload so every candidate genuinely exercises chunking at
+    trial scale — a fidelity trade documented in docs/tuning.md."""
+    import os as _os
+
+    cap = int(
+        _os.environ.get("TFT_TUNE_TRIAL_BYTES", "")
+        or _TRIAL_BYTES_DEFAULT
+    )
+    payload = max(4096, min(2 * default_cb, cap))
+    chunk_cands = sorted(
+        {
+            c
+            for c in (
+                payload // 8, payload // 4, payload // 2, default_cb,
+            )
+            if 0 < c <= payload // 2
+        }
+    )
+    if not chunk_cands:
+        chunk_cands = [max(1, payload // 2)]
+    stream_cands = sorted({2, default_st, 8})
+    grid = [
+        {"chunk_bytes": int(c), "streams": int(s)}
+        for c in chunk_cands
+        for s in stream_cands
+    ]
+    state: dict = {}
+
+    def _payload() -> np.ndarray:
+        buf = state.get("buf")
+        if buf is None:
+            rows = max(1, payload // 4096)
+            buf = state["buf"] = (
+                np.random.default_rng(0)
+                .integers(0, 255, size=(rows, 1024), dtype=np.int64)
+                .astype(np.float32)
+            )
+        return buf
+
+    def feats(cand):
+        chunks = max(1, -(-payload // max(1, int(cand["chunk_bytes"]))))
+        waves = -(-chunks // max(1, int(cand["streams"])))
+        # flops 0 (pure data movement); the bytes term prices the link,
+        # the dispatch term prices per-chunk submission/latency waves
+        return 0.0, float(payload), float(chunks + waves)
+
+    def trial(cand):
+        import jax
+
+        buf = _payload()
+        row_bytes = buf.itemsize * buf.shape[1]
+        rows = max(1, int(cand["chunk_bytes"]) // row_bytes)
+        bounds = [
+            (lo, min(lo + rows, buf.shape[0]))
+            for lo in range(0, buf.shape[0], rows)
+        ]
+        with ThreadPoolExecutor(
+            max_workers=max(1, int(cand["streams"])),
+            thread_name_prefix="tft-tune-link",
+        ) as pool:
+            futs = [
+                pool.submit(jax.device_put, buf[lo:hi])
+                for lo, hi in bounds
+            ]
+            for f in futs:
+                jax.block_until_ready(f.result())
+
+    return grid, feats, trial
+
+
+# ---------------------------------------------------------------------------
 # pool + plan
 # ---------------------------------------------------------------------------
 
@@ -127,14 +250,17 @@ _pool: Optional[ThreadPoolExecutor] = None
 _pool_width = 0
 
 
-def _get_pool() -> ThreadPoolExecutor:
+def _get_pool(width: Optional[int] = None) -> ThreadPoolExecutor:
     """The shared transfer pool, sized to ``Config.transfer_streams``
-    (rebuilt when the knob changes; in-flight work on the old pool
-    drains, it is never cancelled)."""
-    from ..utils import get_config
-
+    (or the autotuner's winner for this link — ``_link_knobs``; rebuilt
+    when the effective width changes; in-flight work on the old pool
+    drains, it is never cancelled). Callers that already resolved the
+    link knobs pass ``width`` so one transfer op sees ONE consistent
+    (chunk, streams) pair instead of re-resolving per helper."""
     global _pool, _pool_width
-    width = max(1, int(get_config().transfer_streams))
+    if width is None:
+        _, width = _link_knobs()
+    width = max(1, int(width))
     with _pool_lock:
         if _pool is None or _pool_width != width:
             # the old pool is NOT shut down: an in-flight transfer that
@@ -171,13 +297,16 @@ def wire_dtype(host_dtype) -> np.dtype:
     return host_dtype
 
 
-def _chunk_bounds(n_rows: int, row_bytes: int) -> List[Tuple[int, int]]:
+def _chunk_bounds(
+    n_rows: int, row_bytes: int, chunk_bytes: Optional[int] = None
+) -> List[Tuple[int, int]]:
     """Row-range chunks for an ``[n_rows, ...]`` transfer. One chunk when
     chunking is off (``transfer_chunk_bytes <= 0``), the payload fits a
-    single chunk, or the array is empty/rowless."""
-    from ..utils import get_config
-
-    chunk_bytes = get_config().transfer_chunk_bytes
+    single chunk, or the array is empty/rowless. Chunk size is the
+    tuned link value when the autotuner has a winner (``_link_knobs``;
+    pass ``chunk_bytes`` when the caller already resolved it)."""
+    if chunk_bytes is None:
+        chunk_bytes, _ = _link_knobs()
     if n_rows <= 1 or chunk_bytes <= 0 or row_bytes <= 0:
         return [(0, n_rows)]
     rows = max(1, int(chunk_bytes // row_bytes))
@@ -196,9 +325,7 @@ def chunk_rows(row_bytes: int) -> int:
     this so a journal block never spans transfer chunks and a resumed
     job re-uploads only its own unfinished blocks' bytes). Effectively
     unbounded when chunking is off."""
-    from ..utils import get_config
-
-    chunk_bytes = get_config().transfer_chunk_bytes
+    chunk_bytes, _ = _link_knobs()
     if chunk_bytes <= 0 or row_bytes <= 0:
         return 1 << 62
     return max(1, int(chunk_bytes // row_bytes))
@@ -324,6 +451,10 @@ class StreamingUpload:
     def __init__(self, arr: np.ndarray, what: str = "column"):
         self.arr = arr
         self.wire = wire_dtype(arr.dtype)
+        # resolve the link knobs ONCE per upload: bounds and pool width
+        # must come from the same (chunk, streams) pair even if a tuned
+        # winner lands mid-transfer
+        chunk_bytes, streams = _link_knobs()
         if arr.ndim == 0:
             # scalars cross whole (they cannot be row-sliced); d2h has
             # the symmetric case
@@ -332,12 +463,14 @@ class StreamingUpload:
             row_bytes = self.wire.itemsize * int(
                 np.prod(arr.shape[1:], initial=1)
             )
-            self.bounds = _chunk_bounds(int(arr.shape[0]), row_bytes)
+            self.bounds = _chunk_bounds(
+                int(arr.shape[0]), row_bytes, chunk_bytes
+            )
         self.what = what
         self._chunks: List[Any] = [None] * len(self.bounds)
         self._assembled = None
         self._lock = threading.Lock()
-        pool = _get_pool()
+        pool = _get_pool(streams)
         self._futs = [
             _submit(
                 pool,
@@ -491,11 +624,16 @@ def d2h_async(dev, what: str = "column"):
         multi_device = len(dev.devices()) > 1
     except Exception:
         pass
+    # one knob resolution per fetch (bounds + pool width stay a
+    # consistent pair; see StreamingUpload)
+    chunk_bytes, streams = _link_knobs()
     bounds = (
         [(0, 0)]
         if not shape
         else _chunk_bounds(
-            shape[0], dtype.itemsize * int(np.prod(shape[1:], initial=1))
+            shape[0],
+            dtype.itemsize * int(np.prod(shape[1:], initial=1)),
+            chunk_bytes,
         )
     )
     if not shape or multi_device or len(bounds) == 1:
@@ -509,7 +647,7 @@ def d2h_async(dev, what: str = "column"):
 
         return _WholeFetch(
             _submit(
-                _get_pool(),
+                _get_pool(streams),
                 _observed, "d2h", fetch_whole, f"frame.d2h {what}",
             )
         )
@@ -524,7 +662,7 @@ def d2h_async(dev, what: str = "column"):
             "d2h", go, f"frame.d2h {what} chunk {i}/{len(bounds)}"
         )
 
-    pool = _get_pool()
+    pool = _get_pool(streams)
     futs = [
         _submit(pool, fetch, i, lo, hi)
         for i, (lo, hi) in enumerate(bounds)

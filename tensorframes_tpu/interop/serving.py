@@ -551,6 +551,9 @@ class ScoringServer:
           tracked — the full points are on ``GET /varz``);
         - ``chaos``: the active chaos spec ("" when clean — anything
           else taints every number on the page);
+        - ``tune``: the self-tuning layer's view
+          (``tensorframes_tpu.tune``: active mode, store path, and
+          every installed/stored tuned winner with its source);
         - ``trace_sink``: whether a JSONL span sink is attached.
 
         Always 200; rendering never touches the engine (a wedged engine
@@ -564,11 +567,21 @@ class ScoringServer:
         from ..utils.config import get_config
         from ..utils import chaos as _chaos_mod
 
+        from .. import tune as _tune
+
         rings = _flight.rings()
         requests = rings.get("serving", [])
         slowest = sorted(
             requests, key=lambda e: e.get("dur_s") or 0.0, reverse=True
         )[:10]
+        try:
+            tune_view = {
+                "mode": _tune.mode(),
+                "store": _tune.store_path(),
+                "winners": _tune.snapshot(),
+            }
+        except Exception:
+            tune_view = None
         payload = {
             "requests": requests[-50:],
             "slowest_requests": slowest,
@@ -583,6 +596,10 @@ class ScoringServer:
             },
             "chaos": _chaos_mod.active_spec(),
             "trace_sink": _trace_sink() is not None,
+            # the self-tuning layer's installed/stored winners
+            # (tensorframes_tpu.tune): which tuned configs this process
+            # is actually running with, and where they came from
+            "tune": tune_view,
         }
         return "200 OK", json.dumps(payload, default=str).encode(
             "utf-8"

@@ -25,6 +25,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.logging import get_logger
+
+logger = get_logger("ops.attention")
+
 __all__ = [
     "flash_attention",
     "attention_reference",
@@ -155,16 +159,129 @@ _BEST_BLOCKS = {
 }
 
 
-def _best_blocks(dtype, d, l):
-    """Measured-best kernel tiles for this (dtype, head_dim, L); see
-    ``_BEST_BLOCKS``. Callers may always override explicitly."""
+def _static_best_blocks(dtype, d, l):
+    """The measured-best table lookup alone (no tuner): the seed prior
+    for the autotuner, and what ``paged_page_size_hint`` reads — the
+    hint wants the table's block_k, which the tuning grid never varies,
+    so consulting the tuner there would only burn a trial budget."""
     is_lowp = dtype in (jnp.bfloat16, jnp.float16)
     d_bucket = 128 if d > 64 else 64
     rows = _BEST_BLOCKS[(is_lowp, d_bucket)]
+    static = rows[-1][1]
     for min_l, blocks in sorted(rows, reverse=True):
         if l >= min_l:
-            return blocks
-    return rows[-1][1]
+            static = blocks
+            break
+    return is_lowp, d_bucket, static
+
+
+def _best_blocks(dtype, d, l):
+    """Kernel tiles for this (dtype, head_dim, L): the autotuner's
+    winner when one is installed (``tensorframes_tpu.tune``, surface
+    ``flash.tiles``), else the measured-best static table
+    ``_BEST_BLOCKS`` — which doubles as the tuner's seed prior (the
+    default candidate every trial set measures first). Callers may
+    always override explicitly."""
+    is_lowp, d_bucket, static = _static_best_blocks(dtype, d, l)
+    return _tuned_flash_blocks(is_lowp, d_bucket, l, static)
+
+
+#: trial sequence cap: long-L signatures micro-benchmark at this length
+#: (tile behavior is L-stable past a few k and interpret-mode trials on
+#: CPU must stay sub-second); the WINNER still installs for the real L
+#: bucket
+_FLASH_TRIAL_L_CAP = 512
+
+
+def _tuned_flash_blocks(is_lowp, d_bucket, l, static):
+    """Consult the autotuner for the flash forward tiles.
+
+    The candidate grid varies **block_q only**: the q tile sets grid
+    parallelism and VMEM residency but leaves every query row's k-axis
+    accumulation untouched, so each candidate is byte-identical to the
+    static default — the tuning contract (docs/tuning.md). ``block_k``
+    changes the online-softmax grouping (float associativity) and
+    therefore stays at the table's measured value."""
+    from .. import tune
+
+    if tune.mode() == "off":
+        return static
+    sq, sk = static
+    lb = 1 << max(7, (int(l) - 1).bit_length())  # pow2 bucket, >= 128
+    sig = f"lowp={int(is_lowp)}|d={d_bucket}|L={lb}"
+    default = {"block_q": int(sq), "block_k": int(sk)}
+    lt = min(lb, _FLASH_TRIAL_L_CAP)
+    # the default is measured CLAMPED to the trial length too, so any
+    # candidate whose clamped trial equals the clamped default's would
+    # run a byte-identical micro-benchmark — a coin-flip winner that
+    # would then persist fleet-wide. Exclude by effective trial tile.
+    eff_default = _fit_tile(min(int(sq), lt), lt)
+    seen_eff = {eff_default}
+    grid = []
+    for bq in (256, 512, 1024, 2048):
+        if bq > lt:
+            # beyond trial fidelity: a candidate wider than the trial
+            # sequence would measure identically to another clamped one
+            # and the winner among them would be timing noise — only
+            # offer what the micro-benchmark can genuinely distinguish
+            continue
+        fq = _fit_tile(bq, lb)
+        if fq is None:
+            continue
+        eff = _fit_tile(min(int(fq), lt), lt)
+        if eff in seen_eff:
+            continue
+        seen_eff.add(eff)
+        cand = {"block_q": int(fq), "block_k": int(sk)}
+        if cand != default:
+            grid.append(cand)
+
+    def feats(cand):
+        # one forward tile does ~4*bq*bk*d MXU flops (qk^T + pv) and
+        # touches the q/k/v/o tiles; tiles-per-sequence is the dispatch
+        # count the overhead weight prices
+        bq = min(cand["block_q"], lt)
+        bk = min(cand["block_k"], lt)
+        itemsize = 2 if is_lowp else 4
+        tiles = max(1, lt // bq) * max(1, lt // bk)
+        flops = 4.0 * bq * bk * d_bucket * tiles
+        nbytes = (2 * bq + 2 * bk) * d_bucket * itemsize * tiles
+        return flops, nbytes, tiles
+
+    def trial(cand):
+        rng = np.random.default_rng(0)
+        dt = jnp.bfloat16 if is_lowp else jnp.float32
+        q = jnp.asarray(
+            rng.normal(size=(1, 1, lt, d_bucket)).astype(np.float32), dt
+        )
+        k = jnp.asarray(
+            rng.normal(size=(1, 1, lt, d_bucket)).astype(np.float32), dt
+        )
+        v = jnp.asarray(
+            rng.normal(size=(1, 1, lt, d_bucket)).astype(np.float32), dt
+        )
+        jax.block_until_ready(
+            flash_attention(
+                q, k, v,
+                block_q=min(cand["block_q"], lt),
+                block_k=min(cand["block_k"], lt),
+            )
+        )
+
+    try:
+        win = tune.lookup(
+            "flash.tiles", sig, default, grid=grid, feats=feats,
+            trial=trial,
+        )
+        bq, bk = int(win["block_q"]), int(win["block_k"])
+        if bq >= 1 and bk >= 1:
+            return (bq, bk)
+    except Exception:
+        logger.warning(
+            "flash tile tuning lookup failed; using the static table",
+            exc_info=True,
+        )
+    return static
 
 
 def _check_tiles(block_q, lq, block_k, lk):
@@ -306,9 +423,11 @@ def paged_page_size_hint(dtype, head_dim: int) -> int:
     the pool, so the tile cannot grow past a page), which makes
     ``page_size`` the paged analog of ``block_k``. Pools sized with this
     page size run the kernel at the sweep's best key tile; smaller pages
-    trade kernel efficiency for finer allocation granularity (the usual
-    serving default of 16 leans all the way toward granularity)."""
-    return _best_blocks(dtype, head_dim, 0)[1]
+    trade kernel efficiency for finer allocation granularity (the old
+    serving default of 16 leaned all the way toward granularity — this
+    hint is now the engine's default, clamped to ``max_seq_len``, with
+    the autotuner's ``serve.page_size`` winner overriding it)."""
+    return _static_best_blocks(dtype, head_dim, 0)[2][1]
 
 
 def _ragged_paged_kernel(

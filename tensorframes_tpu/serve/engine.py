@@ -220,6 +220,13 @@ class GenerationEngine:
     Perf knobs (``None`` falls back to the matching ``Config`` field;
     docs/serving_llm.md):
 
+    - ``page_size``: KV page granularity. Default (``None``) is the
+      measured-best mapping ``ops.paged_page_size_hint`` (one page IS
+      the fused read's key tile) clamped to ``max_seq_len``, or the
+      autotuner's ``serve.page_size`` winner when one is stored
+      (docs/tuning.md); an explicit argument always wins, and
+      ``/healthz`` reports the chosen size;
+
     - ``attention_impl``: ``"gather"`` (reference read,
       ``ops.paged_attention``) or ``"fused"`` (the ragged
       paged-attention Pallas kernel — decode bandwidth scales with live
@@ -241,7 +248,7 @@ class GenerationEngine:
         model,
         *,
         max_slots: int = 8,
-        page_size: int = 16,
+        page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         max_seq_len: Optional[int] = None,
         queue_capacity: int = 64,
@@ -268,7 +275,21 @@ class GenerationEngine:
                 f"positional table ({model_max})"
             )
         self.max_slots = int(max_slots)
-        self.page_size = int(page_size)
+        # dtype only — never np.asarray the embed table (that would
+        # d2h-copy the whole embedding just to read one attribute)
+        kv_dtype = np.dtype(getattr(params["embed"], "dtype", np.float32))
+        if page_size is None:
+            # the measured-best default (ISSUE 13 satellite): one page IS
+            # the fused read's key tile, so the flash sweep's block_k —
+            # ``paged_page_size_hint`` — is the default, clamped to the
+            # sequence bound; the autotuner's ``serve.page_size`` winner
+            # (tuned by tune_serve_knobs / bench.py autotune) overrides
+            # the hint. An EXPLICIT argument wins over both and is
+            # taken verbatim (no clamp — callers pinning a page size
+            # keep exactly the pool layout they asked for).
+            # /healthz reports whatever was chosen.
+            page_size = self._default_page_size(kv_dtype, hd)
+        self.page_size = max(1, int(page_size))
         self._max_pages = pages_needed(self.max_seq_len, self.page_size)
         if num_pages is None:
             num_pages = self.max_slots * self._max_pages
@@ -290,6 +311,15 @@ class GenerationEngine:
         self.attention_impl = attention_impl
         if prefill_chunk_tokens is None:
             prefill_chunk_tokens = cfg.serve_prefill_chunk_tokens
+            if prefill_chunk_tokens == 0:
+                # neither argument nor config asked for chunking: take
+                # the autotuner's winner when one is stored (cache-only
+                # at init — the measured search for the serving knobs
+                # lives in tune.tune_serve_knobs; chunking never changes
+                # emitted tokens, the serve-suite byte-identity)
+                prefill_chunk_tokens = self._tuned_prefill_chunk(
+                    kv_dtype, hd
+                )
         if prefill_chunk_tokens < 0:
             raise ValueError(
                 f"prefill_chunk_tokens must be >= 0; got "
@@ -393,6 +423,58 @@ class GenerationEngine:
         #: step in progress
         self._poison: Optional[BaseException] = None
         _m_pages_capacity.set(float(num_pages))
+
+    # -- tuned serving knobs ----------------------------------------------
+
+    def _default_page_size(self, kv_dtype, head_dim: int) -> int:
+        """Default page size when the caller passed none: the
+        measured-best key-tile mapping (``paged_page_size_hint``, the
+        flash sweep's block_k clamped to ``max_seq_len``), overridden by
+        the autotuner's ``serve.page_size`` winner for this model
+        signature when one is in the store."""
+        from ..ops.attention import paged_page_size_hint
+
+        hint = max(
+            1,
+            min(
+                int(paged_page_size_hint(kv_dtype, head_dim)),
+                self.max_seq_len,
+            ),
+        )
+        try:
+            from .. import tune
+
+            if tune.mode() == "off":
+                return hint
+            win = tune.lookup(
+                "serve.page_size",
+                tune.serve_signature(kv_dtype, head_dim, self.max_seq_len),
+                {"page_size": hint},
+            )
+            # defaulted path: clamp the winner like the hint — a store
+            # row from a longer-sequence world must not oversize pages
+            return max(
+                1, min(int(win.get("page_size", hint)), self.max_seq_len)
+            )
+        except Exception:
+            return hint
+
+    def _tuned_prefill_chunk(self, kv_dtype, head_dim: int) -> int:
+        """The autotuner's ``serve.prefill_chunk`` winner (0 — whole
+        prompts in one pass — when nothing is stored)."""
+        try:
+            from .. import tune
+
+            if tune.mode() == "off":
+                return 0
+            win = tune.lookup(
+                "serve.prefill_chunk",
+                tune.serve_signature(kv_dtype, head_dim, self.max_seq_len),
+                {"tokens": 0},
+            )
+            return max(0, min(int(win.get("tokens", 0)), self.max_seq_len))
+        except Exception:
+            return 0
 
     # -- compiled step builders -------------------------------------------
 
@@ -1194,6 +1276,11 @@ class GenerationEngine:
             "pages_in_use": self.pool.pages_in_use,
             "pages_capacity": self.pool.num_pages,
             "pages_shared": self.pool.pages_shared,
+            # the CHOSEN perf knobs (page size may come from the
+            # measured-best hint or a tuned winner — ISSUE 13): the
+            # probe shows what this engine actually runs with
+            "page_size": self.page_size,
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "prefix_cache": (
                 self.prefix_cache.stats()
                 if self.prefix_cache is not None

@@ -1,0 +1,224 @@
+"""Learned cost predictor: rank the candidate grid so trials stay cheap.
+
+The TPU learned-cost-model line (Kaufman et al., arXiv:2008.01040;
+TpuGraphs, arXiv:2308.13490) trains graph networks over kernel features
+to predict runtimes. This module is the same idea at this engine's
+scale: a **ridge/analytic hybrid** over the three program features the
+observatory already persists per compiled program
+(``obs/programs.py`` → ``programs.jsonl``: FLOPs, bytes accessed,
+per-dispatch wall) —
+
+    wall_per_dispatch  ≈  w_f · flops  +  w_b · bytes  +  w_0
+
+``w_f`` is an effective 1/FLOP-rate, ``w_b`` an effective 1/bandwidth,
+``w_0`` the per-dispatch overhead (trace/launch/link latency). The
+**analytic prior** seeds those weights from the device's known peaks
+(:func:`~tensorframes_tpu.obs.programs.peak_flops` /
+``peak_bytes_per_s``, conservative constants on unknown hosts); the
+**ridge fit** then re-estimates them from this host's own
+``programs.jsonl`` records when enough are available, falling back to
+the prior per-weight when the fit goes unphysical (a negative rate).
+
+The autotuner (:mod:`.search`) uses it only to *rank* candidates —
+measured trials cover the top-K predicted configs and the measurement
+always decides — so a bad prediction costs a wasted trial, never a
+wrong winner. Prediction error is exported as the
+``tune.predicted_error_ratio`` histogram so the model's honesty is a
+dashboard series, not a belief.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+__all__ = [
+    "CostModel",
+    "default_model",
+    "load_cost_records",
+]
+
+logger = get_logger("tune.model")
+
+#: conservative fallback rates for hosts with no peak table entry
+#: (CPU): a few-GFLOP/s core and a DDR-ish link, plus a dispatch
+#: overhead in the tens of microseconds — the ORDERING these produce is
+#: what matters, not the absolute walls
+_FALLBACK_FLOPS_PER_S = 5e10
+_FALLBACK_BYTES_PER_S = 1e10
+_DISPATCH_OVERHEAD_S = 5e-5
+
+#: ridge regularizer (features are pre-scaled to O(1), see _fit)
+_RIDGE_LAMBDA = 1e-3
+#: minimum records before the fit replaces the analytic prior
+_MIN_FIT_RECORDS = 8
+
+
+def _analytic_weights() -> Tuple[float, float, float]:
+    from ..obs.programs import peak_bytes_per_s, peak_flops
+
+    pf = peak_flops() or _FALLBACK_FLOPS_PER_S
+    pb = peak_bytes_per_s() or _FALLBACK_BYTES_PER_S
+    return (1.0 / pf, 1.0 / pb, _DISPATCH_OVERHEAD_S)
+
+
+class CostModel:
+    """``predict(flops, bytes, dispatches)`` → seconds, linear in the
+    features with non-negative weights."""
+
+    __slots__ = ("w_flops", "w_bytes", "w_overhead", "source")
+
+    def __init__(
+        self,
+        w_flops: float,
+        w_bytes: float,
+        w_overhead: float,
+        source: str = "analytic",
+    ):
+        self.w_flops = float(w_flops)
+        self.w_bytes = float(w_bytes)
+        self.w_overhead = float(w_overhead)
+        self.source = source
+
+    @classmethod
+    def analytic(cls) -> "CostModel":
+        return cls(*_analytic_weights(), source="analytic")
+
+    @classmethod
+    def fit(cls, records: Iterable[Dict[str, Any]]) -> "CostModel":
+        """Ridge-fit the weights from program-cost records (rows shaped
+        like ``obs/programs.py``'s JSONL: ``flops``, ``bytes``,
+        ``dispatches``, ``dispatch_s``). Records without all three
+        features, or with zero dispatches, are skipped. Falls back to
+        the analytic prior — per weight — when the data is too thin or
+        the fit yields a negative rate."""
+        prior = _analytic_weights()
+        xs: List[Tuple[float, float]] = []
+        ys: List[float] = []
+        for rec in records:
+            flops = rec.get("flops")
+            nbytes = rec.get("bytes")
+            disp = rec.get("dispatches") or 0
+            wall = rec.get("dispatch_s") or 0.0
+            if flops is None or nbytes is None or disp <= 0 or wall <= 0:
+                continue
+            xs.append((float(flops), float(nbytes)))
+            ys.append(float(wall) / float(disp))
+        if len(xs) < _MIN_FIT_RECORDS:
+            return cls(*prior, source="analytic")
+        x = np.asarray(xs, dtype=np.float64)
+        y = np.asarray(ys, dtype=np.float64)
+        # scale features to O(1) so one lambda regularizes both; the
+        # intercept column is already O(1)
+        scale = np.maximum(x.max(axis=0), 1.0)
+        xn = np.concatenate([x / scale, np.ones((len(x), 1))], axis=1)
+        a = xn.T @ xn + _RIDGE_LAMBDA * np.eye(3)
+        try:
+            w = np.linalg.solve(a, xn.T @ y)
+        except np.linalg.LinAlgError:
+            return cls(*prior, source="analytic")
+        w_f, w_b = float(w[0] / scale[0]), float(w[1] / scale[1])
+        w_0 = float(w[2])
+        # a negative rate is unphysical — that weight keeps its prior
+        # (typical when the records do not span that feature's range)
+        fitted = (
+            w_f if w_f > 0 else prior[0],
+            w_b if w_b > 0 else prior[1],
+            w_0 if w_0 > 0 else prior[2],
+        )
+        source = (
+            "ridge"
+            if (w_f > 0 and w_b > 0 and w_0 > 0)
+            else "ridge+analytic"
+        )
+        return cls(*fitted, source=source)
+
+    def predict(
+        self, flops: float, nbytes: float, dispatches: float = 1.0
+    ) -> float:
+        """Predicted wall seconds for a workload of ``flops`` total
+        FLOPs and ``nbytes`` total bytes run as ``dispatches`` program
+        dispatches."""
+        return (
+            self.w_flops * float(flops)
+            + self.w_bytes * float(nbytes)
+            + self.w_overhead * float(dispatches)
+        )
+
+    def rank(
+        self,
+        candidates: Sequence[Dict[str, Any]],
+        feats,
+    ) -> List[Tuple[Dict[str, Any], float]]:
+        """Candidates with their predicted walls, cheapest-predicted
+        first. ``feats(candidate)`` returns ``(flops, bytes,
+        dispatches)``; a candidate whose features raise ranks last
+        (predicted ``inf``) rather than killing the search."""
+        scored: List[Tuple[Dict[str, Any], float]] = []
+        for cand in candidates:
+            try:
+                f, b, d = feats(cand)
+                scored.append((cand, self.predict(f, b, d)))
+            except Exception:
+                scored.append((cand, float("inf")))
+        scored.sort(key=lambda cp: cp[1])
+        return scored
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "w_flops": self.w_flops,
+            "w_bytes": self.w_bytes,
+            "w_overhead": self.w_overhead,
+            "source": self.source,
+        }
+
+
+def load_cost_records(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """The observatory's persisted program-cost rows
+    (``programs.jsonl``; corrupt lines skipped) — the training set."""
+    from ..obs.programs import costs_path
+
+    target = path or costs_path()
+    rows: List[Dict[str, Any]] = []
+    try:
+        with open(target) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    rows.append(rec)
+    except OSError:
+        return []
+    return rows
+
+
+def default_model(path: Optional[str] = None) -> CostModel:
+    """The model the tuner uses: ridge-fit from this host's persisted
+    program costs when enough records exist, else the analytic prior.
+    Never raises."""
+    try:
+        records = load_cost_records(path)
+        # fold in the LIVE registry too: a fresh process that has
+        # already dispatched programs this session has labels that may
+        # not have autopersisted yet
+        try:
+            from ..obs import programs as _programs
+
+            records = records + [r.as_dict() for r in _programs.programs()]
+        except Exception:
+            pass
+        return CostModel.fit(records)
+    except Exception:
+        logger.warning("cost-model fit failed; using analytic prior",
+                       exc_info=True)
+        return CostModel.analytic()

@@ -139,6 +139,7 @@ SITES = (
     "frame.d2h",
     "fleet.place",
     "fleet.replica_fault",
+    "tune.trial",
 )
 
 #: sites whose code COMPOSES dotted suffixes at runtime (their FAMILY):

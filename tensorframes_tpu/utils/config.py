@@ -172,6 +172,39 @@ class Config:
     #: epilogue — one program computes map outputs AND the block partial;
     #: partials still merge through the reduce's own ``[2, ...]`` program.
     plan_hoist_reduce: bool = True
+    #: master switch for the self-tuning performance layer
+    #: (``tensorframes_tpu.tune``): False makes every tuned surface
+    #: (attention tiles, transfer chunk/streams, serve page size +
+    #: prefill chunk, map-rows block-row budget) fall straight back to
+    #: its static default. ``TFT_TUNE=0`` in the environment forces the
+    #: same off state regardless of this field (checked live — the
+    #: bench-regression gate pins it). See docs/tuning.md.
+    autotune: bool = True
+    #: tuning mode when ``autotune`` is on: ``"cached"`` (default)
+    #: serves winners from the persisted tuning store but never runs a
+    #: measurement trial; ``"online"`` additionally micro-benchmarks the
+    #: candidate grid on first sight of an unseen signature and installs
+    #: + persists the winner; ``"off"`` equals ``autotune=False``.
+    tune_mode: str = "cached"
+    #: wall-clock budget for one signature's online tuning pass,
+    #: seconds: candidates are measured in predicted-cost order until
+    #: the budget runs out, and the winner is picked among whatever was
+    #: measured (the static default is always measured first, so a
+    #: budget too small for the grid degrades to "keep the default").
+    tune_budget_s: float = 2.0
+    #: timed repeats per measured candidate (the winner is the
+    #: median-wall candidate; one untimed warmup per candidate pays any
+    #: compile cost outside the measurement).
+    tune_trials: int = 3
+    #: cap on candidates measured per signature AFTER the learned cost
+    #: model ranks the grid — measured trials cover only the top-K
+    #: predicted configs, and never more than half the full grid.
+    tune_top_k: int = 4
+    #: path of the persisted tuning store (JSONL). Empty means
+    #: ``$TFT_TUNE_FILE``, else ``tune.jsonl`` next to the XLA
+    #: persistent compile cache directory (the same
+    #: ``~/.cache/tensorframes_tpu`` trajectory home).
+    tune_file: str = ""
 
 
 _lock = threading.Lock()

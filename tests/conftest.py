@@ -53,6 +53,22 @@ def _program_costs_in_tmp(tmp_path_factory):
     )
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _tune_store_in_tmp(tmp_path_factory):
+    """The self-tuning layer's persisted store (tensorframes_tpu/tune)
+    reads/writes the test session's tmp dir: tests must neither pollute
+    the developer's store nor inherit its stale winners (a tuned
+    block-row budget from a bench run would silently change every
+    map_rows plan under test). Unlike the debug/costs fixtures above
+    this one FORCES the path — an inherited TFT_TUNE_FILE (e.g. the
+    shared fleet store docs/tuning.md recommends exporting) would both
+    leak winners INTO the tests and let the pin/clear/put drills wipe
+    real fleet entries."""
+    os.environ["TFT_TUNE_FILE"] = str(
+        tmp_path_factory.mktemp("tune-store") / "tune.jsonl"
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
