@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -84,6 +84,15 @@ test-plan:
 test-tune:
 	$(PY) -m pytest tests/ -q -m tune
 
+# the tensor-parallel serving suite (serve/tp.py: mesh-sharded step
+# programs + sharded KV PagePool — the TP=1/2/4 byte-identity matrix,
+# capacity scaling, hetero-TP fleet failover). Part of tier-1 (conftest
+# provisions the simulated mesh); this target also sets the
+# host-device-count env itself so it works OUTSIDE pytest's conftest,
+# e.g. under a bare `python -m pytest tests/test_serve_tp.py::...`
+test-tp:
+	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" $(PY) -m pytest tests/ -q -m tp
+
 # just the real 2-process distributed suite
 test-multihost:
 	$(PY) -m pytest tests/test_multihost.py -q
@@ -93,8 +102,11 @@ bench:
 	$(PY) bench.py
 
 # serving trajectory: tokens/s + inter-token latency at 1/4/16 concurrency,
-# plus the fleet's aggregate tokens/s at 1/2/4 replicas
-# (TFT_BENCH_REPLICAS=1,2 shrinks the replicas axis for smoke runs)
+# the fleet's aggregate tokens/s at 1/2/4 replicas, and the
+# tensor-parallel axis — one replica spanning TP=1/2/4 simulated chips
+# with tok/s + aggregate KV pages per degree
+# (TFT_BENCH_REPLICAS=1,2 and TFT_BENCH_TP=1,2 shrink axes for smoke
+# runs; empty TFT_BENCH_TP disables the TP axis entirely)
 bench-serve:
 	$(PY) bench.py decode_serve
 

@@ -377,6 +377,7 @@ def _pct(xs, p):
 
 def _serve_one_concurrency(
     lm, n_requests, plen, max_new, seed, prompts=None, page_size=16,
+    stats_out=None,
     **engine_kw,
 ):
     """One timed serving run: ``n_requests`` streams decoded through one
@@ -447,6 +448,16 @@ def _serve_one_concurrency(
             st["hits"] / max(1, st["lookups"]), 3
         )
         out["prefix_cache_tokens_saved"] = st["tokens_saved"]
+    if stats_out is not None:
+        # engine-side views an axis wants WITHOUT rebuilding the engine
+        # (a second TP engine would re-run the collective estimate and
+        # allocate a duplicate sharded pool): the health snapshot plus
+        # raw pool byte counts
+        stats_out["health"] = eng.health()
+        stats_out["pool_num_pages"] = eng.pool.num_pages
+        stats_out["pool_kv_nbytes"] = int(
+            eng.pool.k.nbytes + eng.pool.v.nbytes
+        )
     return out
 
 
@@ -490,8 +501,67 @@ def _serve_fleet_aggregate(lm, replicas, n_requests=16, plen=32, max_new=64,
     }
 
 
+def _serve_tp_level(lm, degree, plen, max_new, seed, n_requests=16):
+    """One tensor-parallel degree of the ``TFT_BENCH_TP`` axis: the
+    concurrency-16 serving workload with ONE engine spanning ``degree``
+    devices (``GenerationEngine(mesh=...)``, serve/tp.py), reporting
+    tok/s plus the aggregate-KV-capacity view — total pool pages and
+    per-chip KV bytes for a FIXED per-chip page budget (``num_pages``
+    is per-chip under TP, so capacity scales ×N while bytes/chip stay
+    flat). Degrees beyond the attached device count report a skip
+    instead of failing the whole bench."""
+    import jax
+
+    from tensorframes_tpu.parallel import make_mesh
+    from tensorframes_tpu.serve import pages_needed
+
+    if degree > len(jax.devices()):
+        return {
+            "skipped": (
+                f"needs {degree} devices; "
+                f"{len(jax.devices())} attached"
+            )
+        }
+    mesh = make_mesh({"tp": degree}) if degree > 1 else None
+    page_size = 16
+    per_chip_pages = n_requests * pages_needed(plen + max_new, page_size)
+    stats = {}
+    res = _serve_one_concurrency(
+        lm, n_requests, plen=plen, max_new=max_new, seed=seed,
+        page_size=page_size, num_pages=per_chip_pages, mesh=mesh,
+        stats_out=stats,
+    )
+    tp_block = stats["health"]["tp"]
+    res.update(
+        tp_degree=degree,
+        kv_pages_capacity=stats["pool_num_pages"],
+        kv_bytes_per_chip=stats["pool_kv_nbytes"] // max(1, degree),
+        collective_seconds_per_step_est=(
+            tp_block["collective_seconds_per_step_est"] if tp_block
+            else 0.0
+        ),
+    )
+    return res
+
+
 def main_decode_serve():
     import os
+    import sys
+
+    # the TP axis needs a multi-device mesh; on a CPU host that is the
+    # simulated one. The flag only multiplies the HOST platform's
+    # devices (a TPU run's device list is untouched), and it must land
+    # before jax initializes its backends — harmless no-op when some
+    # earlier import beat us to it (the axis then skips degrees that
+    # don't fit and says so in the JSON).
+    if os.environ.get("TFT_BENCH_TP", "1,2,4").strip() and (
+        "jax" not in sys.modules
+    ):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     import jax
 
@@ -569,6 +639,20 @@ def main_decode_serve():
         rep_levels[str(r)] = _serve_fleet_aggregate(
             lm, r, plen=plen, max_new=max_new, seed=100 + r
         )
+    # the tensor-parallel axis (ISSUE 14): one replica spanning 1/2/4
+    # devices of the simulated mesh — tok/s + aggregate KV pages per
+    # degree (TFT_BENCH_TP trims/extends; empty disables the axis, as
+    # the bench-check gate pins it). On the CPU-sim mesh the "chips"
+    # share one socket, so tok/s mostly measures collective/dispatch
+    # overhead there and true FLOP/HBM scaling only on real chips; the
+    # CAPACITY column (pages_capacity ×N for the same per-chip budget)
+    # is exact everywhere.
+    tp_env = os.environ.get("TFT_BENCH_TP", "1,2,4")
+    tp_levels = {}
+    for d in [int(x) for x in tp_env.split(",") if x.strip()]:
+        tp_levels[str(d)] = _serve_tp_level(
+            lm, d, plen=plen, max_new=max_new, seed=200 + d
+        )
     # observability-cost axis (ISSUE 10): the same per-request shape
     # with tracing LIVE (JSONL sink attached — every span on the
     # prefill/decode path materializes and serializes) vs the TFT_OBS=0
@@ -598,6 +682,7 @@ def main_decode_serve():
                     "attention_impl": attention,
                     "shared_prefix": shared_prefix,
                     "replicas": rep_levels,
+                    "tensor_parallel": tp_levels,
                     "observability": observability,
                     # a chaos-tainted number must never be mistaken for a
                     # clean one (the injection sites sit on this path; the

@@ -52,6 +52,10 @@ GATE_ENV = {
     "TFT_BENCH_JOB_WORKERS": "",  # skip the K-subprocess drain axis
     "TFT_BENCH_REPLICAS": "1",
     "TFT_BENCH_PROMPT_LENS": "32",
+    # the tensor-parallel axis (TFT_BENCH_TP, ISSUE 14) pinned OFF:
+    # mesh engines compile three extra shard_map programs per degree —
+    # trajectory material for `make bench-serve`, not gate material
+    "TFT_BENCH_TP": "",
     # the autotuner kill switch, pinned OFF: tuning trials (and a
     # winner that drifts between baseline recording and a later check)
     # must not pollute the regression baseline — the gate measures the

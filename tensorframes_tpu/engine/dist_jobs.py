@@ -712,6 +712,30 @@ class _DistLedger(BlockLedger):
 # ---------------------------------------------------------------------------
 
 
+def _tuned_lease_ttl(default_s: float) -> float:
+    """The autotuner's ``jobs.lease_ttl`` winner when one is stored
+    (docs/tuning.md), else ``default_s`` (``Config.job_lease_ttl_s``).
+    Cache-only on the drain path — there is no sane in-worker trial for
+    a liveness/safety tradeoff; winners come from operator pins or the
+    fleet's shared store. An explicit ``lease_ttl_s`` argument never
+    reaches here (it always wins), and the TTL changes only WHEN a dead
+    worker's blocks reclaim, never block results — the no-behavior-
+    change contract every tuned surface carries."""
+    try:
+        from .. import tune
+
+        if tune.mode() == "off":
+            return default_s
+        win = tune.lookup(
+            "jobs.lease_ttl", tune.jobs_signature(),
+            {"ttl_s": default_s},
+        )
+        ttl = float(win.get("ttl_s", default_s))
+        return ttl if ttl > 0 else default_s
+    except Exception:
+        return default_s
+
+
 def _default_worker_id() -> str:
     return (
         f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:4]}"
@@ -844,7 +868,7 @@ def run_worker(
         raise ValueError(f"unknown job op {op!r}; expected one of {_OPS}")
     cfg = get_config()
     ttl = float(lease_ttl_s if lease_ttl_s is not None
-                else cfg.job_lease_ttl_s)
+                else _tuned_lease_ttl(cfg.job_lease_ttl_s))
     hb = float(heartbeat_s if heartbeat_s is not None
                else cfg.job_heartbeat_s)
     worker_id = worker_id or _default_worker_id()

@@ -554,10 +554,17 @@ class ScoringServer:
         - ``tune``: the self-tuning layer's view
           (``tensorframes_tpu.tune``: active mode, store path, and
           every installed/stored tuned winner with its source);
+        - ``serving``: the engine/fleet health snapshot — per replica:
+          ``tp_degree`` and (under tensor parallelism) the ``tp`` block
+          with sharded-pool capacity, per-shard pages in use, and
+          per-shard KV bytes, so operators see capacity scaling with
+          the mesh at a glance (ISSUE 14);
         - ``trace_sink``: whether a JSONL span sink is attached.
 
-        Always 200; rendering never touches the engine (a wedged engine
-        must not take the status page down with it)."""
+        Always 200; rendering reads only lock-light engine counters
+        (the same ``health()`` snapshot ``/healthz`` serves — safe even
+        against a wedged stepping thread, which holds the step lock,
+        not the bookkeeping locks) and never dispatches device work."""
         import json
 
         from ..obs import programs as _programs
@@ -596,6 +603,10 @@ class ScoringServer:
             },
             "chaos": _chaos_mod.active_spec(),
             "trace_sink": _trace_sink() is not None,
+            # the serving topology: engine (or per-replica fleet)
+            # health incl. tensor-parallel degree and sharded-pool
+            # capacity — never 500s the status page over a sick engine
+            "serving": self._serving_view(),
             # the self-tuning layer's installed/stored winners
             # (tensorframes_tpu.tune): which tuned configs this process
             # is actually running with, and where they came from
@@ -604,6 +615,18 @@ class ScoringServer:
         return "200 OK", json.dumps(payload, default=str).encode(
             "utf-8"
         ), {}
+
+    def _serving_view(self):
+        """The engine's (or fleet's) ``health()`` snapshot for
+        ``/statusz``, None when this server is a pure Arrow scorer;
+        exceptions degrade to an ``"error"`` stub — the status page
+        always renders."""
+        if self._engine is None:
+            return None
+        try:
+            return self._engine.health()
+        except Exception as e:  # pragma: no cover - defensive
+            return {"error": f"{type(e).__name__}: {e}"}
 
     @staticmethod
     def _handle_varz(query: str = "") -> Tuple[str, bytes, Dict[str, str]]:

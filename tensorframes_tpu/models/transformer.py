@@ -27,6 +27,8 @@ __all__ = [
     "transformer_step",
     "transformer_prefill",
     "transformer_prefill_chunk",
+    "transformer_tp_specs",
+    "gather_tp_params",
     "transformer_loss",
     "token_nll",
     "TransformerLM",
@@ -526,6 +528,87 @@ def transformer_prefill_chunk(params, tokens, positions, attend,
                 jnp.asarray(block["down"])
             )
     return _ln(h, params["ln_f"]) @ embed.T
+
+
+def transformer_tp_specs(params, axis: str = "tp"):
+    """PartitionSpec tree for the TENSOR-PARALLEL SERVING weight layout
+    (``params`` WITHOUT the ``n_heads`` entry — the device tree the
+    serving engine ships): every large matrix is sharded AT REST along
+    its hidden-ish axis — ``qkv`` and ``up`` on their output columns,
+    ``proj`` and ``down`` on their input rows (= the MLP hidden dim) —
+    while embeddings, positions, and layernorms stay replicated (the
+    embedding is read by token lookup AND the tied head, both of which
+    want full rows). Per-chip weight HBM shrinks ~1/N with the mesh.
+
+    The compute plan (:mod:`tensorframes_tpu.serve.tp`) gathers these
+    shards back to FULL weights inside each step program
+    (:func:`gather_tp_params`) and runs every matmul at the solo
+    program's exact shapes. That is deliberate: the serving contract is
+    byte-identical decode streams at every TP degree, and neither
+    Megatron row-parallel partial sums nor column-sliced GEMMs preserve
+    float reduction order — an all-gathered shard tree, by contrast,
+    reconstructs the solo weights bit-for-bit. The sharded COMPUTE lives
+    where it is bit-exact by construction: the per-KV-head paged
+    attention walk and the page pool, which are batch-indexed in the
+    head axis. ``MoE`` blocks have no serving TP plan yet — rejected
+    here so the error names the gap."""
+    from jax.sharding import PartitionSpec as P
+
+    rep = P()
+
+    def ln_spec():
+        return {"g": rep, "b": rep}
+
+    blocks = []
+    for i, block in enumerate(params["blocks"]):
+        if "moe" in block:
+            raise ValueError(
+                f"block {i} is a mixture-of-experts block; tensor-"
+                f"parallel serving shards dense blocks only (MoE serving "
+                f"shards over an 'ep' mesh — not wired into the engine "
+                f"yet)"
+            )
+        blocks.append(
+            {
+                "ln1": ln_spec(),
+                "qkv": P(None, axis),
+                "proj": P(axis, None),
+                "ln2": ln_spec(),
+                "up": P(None, axis),
+                "down": P(axis, None),
+            }
+        )
+    return {
+        "embed": rep,
+        "pos": rep,
+        "ln_f": ln_spec(),
+        "blocks": blocks,
+    }
+
+
+def gather_tp_params(p_loc, axis: str = "tp"):
+    """Inside a ``shard_map`` body: all-gather the weight shards of
+    :func:`transformer_tp_specs`'s layout back to FULL weights. Tiled
+    gathers concatenate the shards in mesh order along the sharded axis,
+    so the gathered tree is bit-for-bit the solo weight tree — the
+    property the byte-identical-streams contract of
+    :mod:`tensorframes_tpu.serve.tp` rides on."""
+    import jax
+
+    def g(a, ax):
+        return jax.lax.all_gather(a, axis, axis=ax, tiled=True)
+
+    blocks = [
+        {
+            **b,
+            "qkv": g(b["qkv"], 1),
+            "proj": g(b["proj"], 0),
+            "up": g(b["up"], 1),
+            "down": g(b["down"], 0),
+        }
+        for b in p_loc["blocks"]
+    ]
+    return {**p_loc, "blocks": blocks}
 
 
 def transformer_generate(

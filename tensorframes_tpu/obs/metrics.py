@@ -30,6 +30,7 @@ histogram observations, and span emission become no-ops.
 from __future__ import annotations
 
 import bisect
+import math
 import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -361,6 +362,12 @@ def _prom_labels(names: Tuple[str, ...], key: Tuple[str, ...], extra="") -> str:
 
 def _fmt(v: float) -> str:
     f = float(v)
+    if math.isinf(f):
+        # Prometheus exposition spelling; int(inf) raises, and one bad
+        # observation must never 500 the whole scrape
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
     return str(int(f)) if f == int(f) else repr(f)
 
 

@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 
 from .attention import flash_attention
-from .seq_common import SEQ_AXIS, check_divisible, resolve_sp_mesh
+from .seq_common import (
+    SEQ_AXIS,
+    axis_size as _axis_size,
+    check_divisible,
+    resolve_sp_mesh,
+)
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
@@ -42,7 +47,7 @@ def ulysses_attention_sharded(
     """Per-shard body: call inside ``shard_map`` with q/k/v sequence chunks
     ``[B, H, L/n, D]`` sharded over ``axis_name``; returns the local output
     chunk. Heads must divide by the axis size."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     h = q.shape[1]
     if h % n:
         raise ValueError(
@@ -73,13 +78,15 @@ def ulysses_attention_sharded(
 def _ulysses_program(mesh, causal: bool, axis_name: str, batch_axis=None):
     from jax.sharding import PartitionSpec as P
 
+    from ..parallel.compat import shard_map as _shard_map
+
     # interpret must follow the MESH's devices, not the default backend:
     # the multichip dryrun runs this over virtual CPU devices on a box
     # whose default platform is a TPU
     interpret = mesh.devices.flat[0].platform != "tpu"
     spec = P(batch_axis, None, axis_name, None)
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             functools.partial(
                 ulysses_attention_sharded,
                 causal=causal,

@@ -65,6 +65,7 @@ from ..engine.validation import (
 from ..frame import GroupedFrame, TensorFrame
 from ..schema import FrameInfo, Shape, Unknown
 from ..utils import get_config, get_logger
+from .compat import shard_map as _shard_map
 from .mesh import DATA_AXIS, default_mesh
 
 __all__ = ["map_blocks", "map_rows", "reduce_blocks", "reduce_rows", "aggregate"]
@@ -145,7 +146,7 @@ def _shard_mapped(g, mesh, body, kind: str, const_names=()):
 
     def build():
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 body,
                 mesh=mesh,
                 in_specs=(
@@ -533,7 +534,7 @@ def reduce_rows(fetches, dframe: TensorFrame, mesh=None):
             g,
             (mesh, "reduce_rows"),
             lambda: jax.jit(
-                jax.shard_map(
+                _shard_map(
                     prog,
                     mesh=mesh,
                     in_specs=({f: P(DATA_AXIS) for f in fetch_names},),
@@ -663,7 +664,7 @@ def aggregate(
             g,
             (mesh, "aggregate"),
             lambda: jax.jit(
-                jax.shard_map(
+                _shard_map(
                     scan_body,
                     mesh=mesh,
                     in_specs=(

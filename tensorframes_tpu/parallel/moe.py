@@ -24,6 +24,8 @@ from typing import Dict
 
 import numpy as np
 
+from .compat import axis_size as _axis_size, shard_map as _shard_map
+
 __all__ = [
     "init_moe",
     "moe_ffn",
@@ -153,7 +155,7 @@ def _moe_program(mesh, axis_name: str, k: int = 1):
         "b_down": P(axis_name),
     }
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             functools.partial(moe_ffn_sharded, axis_name=axis_name, k=k),
             mesh=mesh,
             in_specs=(expert_sharded, P()),
@@ -212,7 +214,7 @@ def _dispatch_body(params, x, capacity, axis_name, k):
     import jax
     import jax.numpy as jnp
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     t_local, d = x.shape
     n_local = params["w_up"].shape[0]
     n_experts = n * n_local
@@ -292,7 +294,7 @@ def _dispatch_program(mesh, capacity: int, axis_name: str, k: int):
         "b_down": P(axis_name),
     }
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             functools.partial(
                 _dispatch_body, capacity=capacity, axis_name=axis_name, k=k
             ),

@@ -22,6 +22,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .compat import shard_map as _shard_map
+
 __all__ = [
     "initialize",
     "is_multihost",
@@ -543,7 +545,7 @@ def reduce_rows(fetches, local_df, mesh):
         g,
         (mesh, "mh_reduce_rows"),
         lambda: jax.jit(
-            jax.shard_map(
+            _shard_map(
                 prog_body,
                 mesh=mesh,
                 in_specs=({f: _dp_spec() for f in fetch_names},),
@@ -571,11 +573,9 @@ def _allgather_partials(partials_df):
     per locally-seen group — the only data that crosses hosts, same as the
     reference's partial-aggregation shuffle (``DebugRowOps.scala:547-592``).
     """
-    from jax.experimental import multihost_utils
-
     from ..frame import TensorFrame
+    from .compat import process_allgather_stacked as ag
 
-    ag = multihost_utils.process_allgather
     nproc = process_count()
     local_n = partials_df.num_rows
     counts = np.asarray(

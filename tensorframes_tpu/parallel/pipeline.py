@@ -19,6 +19,8 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable
 
+from .compat import axis_size as _axis_size, shard_map as _shard_map
+
 __all__ = ["pipeline_apply", "pipeline_reference", "pipeline_train_step"]
 
 #: canonical pipeline axis name
@@ -75,7 +77,7 @@ def _pipeline_body(
     import jax
     import jax.numpy as jnp
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     first = my == 0
     last = my == n - 1
@@ -145,7 +147,7 @@ def _pipeline_program(stage_fn, n_micro, mesh, axis_name, batch_axis=None):
     # when given: pp x dp in one program
     x_spec = P(None, batch_axis)
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis_name), x_spec),
@@ -255,7 +257,7 @@ def _pipeline_1f1b_body(
 
     from ..ops.seq_common import pcast_varying
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     first = my == 0
     last = my == n - 1
@@ -360,7 +362,7 @@ def _pipeline_1f1b_body(
         # dx stays per-shard (each shard's cotangent rows are its own) but
         # needs the same 1/nb: the global loss is the mean of shard-local
         # mean losses, so every shard-local derivative carries 1/nb.
-        nb = jax.lax.axis_size(batch_axis)
+        nb = _axis_size(batch_axis)
         loss_acc = jax.lax.psum(loss_acc, batch_axis) / nb
         grads = jax.tree.map(
             lambda a: jax.lax.psum(a, batch_axis) / nb, grads
@@ -403,7 +405,7 @@ def _pipeline_train_program(
             return loss_sum * inv, grads, extra_grads, dxs * inv
 
         return jax.jit(
-            jax.shard_map(
+            _shard_map(
                 body,
                 mesh=mesh,
                 in_specs=(P(axis_name), P(), x_spec, x_spec),
@@ -420,7 +422,7 @@ def _pipeline_train_program(
     # GPipe: autodiff straight through the forward schedule (shard_map,
     # ppermute and scan all transpose); simple and the correctness oracle
     # for 1f1b, at O(n_micro) checkpointed activations per chip
-    fwd = jax.shard_map(
+    fwd = _shard_map(
         lambda stacked, x_micro: _pipeline_body(
             stage_fn,
             n_micro,

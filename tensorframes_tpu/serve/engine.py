@@ -169,6 +169,19 @@ _m_prefill_chunks = _counter(
     "Prefill chunks dispatched (chunked prefill and prefix-cache "
     "resume both count)",
 )
+_m_tp_degree = _gauge(
+    "serve.tp_degree",
+    "Tensor-parallel degree of the engine's step programs (chips per "
+    "replica; 1 = solo single-chip serving), per engine",
+    labels=("engine",),
+)
+_m_collective_s = _counter(
+    "serve.collective_seconds",
+    "ESTIMATED wall seconds spent in cross-chip collectives by the "
+    "tensor-parallel step programs (per-step estimate from a one-time "
+    "micro-measurement of the step's gather pattern at engine init — "
+    "the real gathers overlap compute inside the compiled step)",
+)
 
 
 _engine_seq_lock = threading.Lock()
@@ -237,7 +250,19 @@ class GenerationEngine:
     - ``prefix_cache``: share identical page-aligned prompt prefixes
       (system prompts, few-shot templates) as refcounted KV pages with
       copy-on-write on in-page divergence; repeat prefixes skip their
-      prefill entirely.
+      prefill entirely;
+    - ``mesh``: a 1-D :class:`jax.sharding.Mesh` makes THIS replica
+      span its chips (tensor parallelism, ``serve/tp.py``): the same
+      three step programs compile as ``jit(shard_map(...))`` — weights
+      sharded at rest and gathered bit-exactly inside the step, the KV
+      pool and the paged attention walk sharded along KV heads — so
+      decode streams stay byte-identical to solo at every TP degree
+      while per-chip weight/KV memory scales ~1/N. ``num_pages``
+      becomes the PER-CHIP page budget (the pool holds
+      ``num_pages × N`` total — aggregate KV capacity scales with the
+      mesh). Requires ``n_heads``/``n_kv_heads``/``d_ff`` divisible by
+      the mesh size; dense (non-MoE) blocks only
+      (docs/serving_llm.md "Tensor parallelism").
 
     A third compiled program (the ``[1, chunk]`` prefill-chunk step)
     exists only when chunked prefill or the prefix cache dispatches it:
@@ -247,7 +272,7 @@ class GenerationEngine:
         self,
         model,
         *,
-        max_slots: int = 8,
+        max_slots: Optional[int] = None,
         page_size: Optional[int] = None,
         num_pages: Optional[int] = None,
         max_seq_len: Optional[int] = None,
@@ -259,6 +284,7 @@ class GenerationEngine:
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache: Optional[bool] = None,
         name: Optional[str] = None,
+        mesh=None,
     ):
         import jax
 
@@ -274,10 +300,40 @@ class GenerationEngine:
                 f"max_seq_len {self.max_seq_len} exceeds the model's "
                 f"positional table ({model_max})"
             )
-        self.max_slots = int(max_slots)
         # dtype only — never np.asarray the embed table (that would
         # d2h-copy the whole embedding just to read one attribute)
         kv_dtype = np.dtype(getattr(params["embed"], "dtype", np.float32))
+        #: the ``serve.page_slots`` winner for this model signature when
+        #: one is stored (None otherwise) — pool GEOMETRY: decode slots
+        #: × pages per slot. Cached-mode-safe like every init-time knob:
+        #: consulted only where the caller passed no explicit value
+        #: (slot count and pool size change scheduling, never streams —
+        #: the serve-suite byte-identity).
+        self._tuned_geometry = self._tuned_page_slots(kv_dtype, hd)
+        if max_slots is None:
+            max_slots = 8
+            if self._tuned_geometry is not None:
+                max_slots = max(
+                    1, int(self._tuned_geometry.get("slots", 8))
+                )
+        self.max_slots = int(max_slots)
+        #: tensor parallelism (docs/serving_llm.md "Tensor parallelism",
+        #: serve/tp.py): a 1-D jax Mesh makes THIS replica span its
+        #: chips — weights sharded at rest, the KV pool and paged
+        #: attention sharded along KV heads, decode streams
+        #: byte-identical to solo at every degree
+        self.mesh = mesh
+        self.tp_degree = 1
+        self._tp_axis: Optional[str] = None
+        if mesh is not None:
+            from .tp import validate_tp_mesh
+
+            blk0 = params["blocks"][0]
+            d_ff = (
+                int(np.shape(blk0["up"])[1]) if "up" in blk0 else 0
+            )
+            self._tp_axis = validate_tp_mesh(mesh, n_heads, n_kv, d_ff)
+            self.tp_degree = int(mesh.devices.size)
         if page_size is None:
             # the measured-best default (ISSUE 13 satellite): one page IS
             # the fused read's key tile, so the flash sweep's block_k —
@@ -292,13 +348,56 @@ class GenerationEngine:
         self.page_size = max(1, int(page_size))
         self._max_pages = pages_needed(self.max_seq_len, self.page_size)
         if num_pages is None:
-            num_pages = self.max_slots * self._max_pages
+            pps = self._max_pages
+            if self._tuned_geometry is not None:
+                # the tuned pool geometry may oversubscribe (fewer pages
+                # per slot than full coverage — preempt-and-requeue is
+                # the relief valve), never undercut feasibility: the
+                # pool always holds at least one full-length request.
+                # Like an explicit ``num_pages``, a tuned budget is a
+                # PER-CHIP quantity, so it scales by the TP degree —
+                # only the untuned full-coverage default (which can
+                # never preempt) skips the multiply.
+                pps = max(
+                    1,
+                    min(
+                        int(
+                            self._tuned_geometry.get(
+                                "pages_per_slot", pps
+                            )
+                        ),
+                        self._max_pages,
+                    ),
+                )
+                num_pages = max(
+                    self._max_pages,
+                    self.max_slots * pps * self.tp_degree,
+                )
+            else:
+                num_pages = self.max_slots * pps
+        elif self.tp_degree > 1:
+            # ``num_pages`` is the PER-CHIP page budget: a page spans
+            # the mesh's shards (1/N of its solo bytes per chip), so a
+            # fixed per-chip HBM budget holds N× the pages — aggregate
+            # KV capacity scales with the TP degree, which is what lets
+            # a workload that exhausts TP=1 admission serve
+            # preemption-free at TP=2 (``serve.pages_capacity`` reports
+            # the scaled total)
+            num_pages = int(num_pages) * self.tp_degree
+        kv_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from .tp import tp_kv_specs
+
+            kv_sharding = NamedSharding(mesh, tp_kv_specs(self._tp_axis))
         self.pool = PagePool(
             n_layers=len(params["blocks"]),
             n_kv_heads=n_kv,
             head_dim=hd,
             num_pages=num_pages,
             page_size=self.page_size,
+            sharding=kv_sharding,
         )
         cfg = get_config()
         if attention_impl is None:
@@ -343,11 +442,33 @@ class GenerationEngine:
         self.eos_id = eos_id
         self._d_model = d_model
         # weights enter the compiled steps as an ARGUMENT (swap-safe, like
-        # TransformerLM.generate); one device copy held for the lifetime
+        # TransformerLM.generate); one device copy held for the lifetime.
+        # Under tensor parallelism the copy is SHARDED AT REST per
+        # transformer_tp_specs (qkv/up on output columns, proj/down on
+        # hidden rows — per-chip weight HBM scales ~1/N); the step
+        # programs gather shards back to bit-exact full weights inside
+        # the mesh (serve/tp.py).
         self._host_params = params
-        self._params_dev = jax.device_put(
-            {k: v for k, v in params.items() if k != "n_heads"}
-        )
+        host = {k: v for k, v in params.items() if k != "n_heads"}
+        self._tp_param_specs = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from ..models.transformer import transformer_tp_specs
+
+            self._tp_param_specs = transformer_tp_specs(
+                host, self._tp_axis
+            )
+            self._params_dev = jax.device_put(
+                host,
+                jax.tree.map(
+                    lambda s: NamedSharding(mesh, s),
+                    self._tp_param_specs,
+                    is_leaf=lambda x: not isinstance(x, (dict, list)),
+                ),
+            )
+        else:
+            self._params_dev = jax.device_put(host)
         #: display name for telemetry — the fleet passes its replica
         #: names so the cost registry and /statusz attribute each step
         #: program to its replica; the sequence keeps registry KEYS
@@ -367,20 +488,36 @@ class GenerationEngine:
             max_slots=self.max_slots, page_size=self.page_size,
             max_seq_len=self.max_seq_len, d_model=d_model,
             attention_impl=self.attention_impl,
+            tp_degree=self.tp_degree,
         )
+        if mesh is not None:
+            # the SAME three step programs, as jit(shard_map(...)) over
+            # the mesh (serve/tp.py): identical call signatures, shapes,
+            # and — the serving contract — identical emitted bytes
+            from . import tp as _tp
+
+            ax = self._tp_axis
+            prefill_fn = _tp.tp_prefill_impl(
+                self, mesh, ax, n_heads, moe_top_k
+            )
+            decode_fn = _tp.tp_decode_impl(
+                self, mesh, ax, n_heads, moe_top_k
+            )
+            chunk_fn = _tp.tp_prefill_chunk_impl(
+                self, mesh, ax, n_heads, moe_top_k
+            )
+        else:
+            prefill_fn = self._prefill_impl(n_heads, moe_top_k)
+            decode_fn = self._decode_impl(n_heads, moe_top_k)
+            chunk_fn = self._prefill_chunk_impl(n_heads, moe_top_k)
         self._prefill_jit = _programs.instrument(
-            jax.jit(
-                self._prefill_impl(n_heads, moe_top_k),
-                donate_argnums=donate,
-            ),
+            jax.jit(prefill_fn, donate_argnums=donate),
             key=f"serve.{seq}:prefill",
             name=f"serve.prefill[{self.name}]",
             kind="serve.step", sync=True, **mmeta,
         )
         self._decode_jit = _programs.instrument(
-            jax.jit(
-                self._decode_impl(n_heads, moe_top_k), donate_argnums=donate
-            ),
+            jax.jit(decode_fn, donate_argnums=donate),
             key=f"serve.{seq}:decode",
             name=f"serve.decode[{self.name}]",
             kind="serve.step", sync=True, **mmeta,
@@ -389,10 +526,7 @@ class GenerationEngine:
         # it only dispatches — and only then counts a program — when
         # chunked prefill or a prefix-cache resume needs it
         self._prefill_chunk_jit = _programs.instrument(
-            jax.jit(
-                self._prefill_chunk_impl(n_heads, moe_top_k),
-                donate_argnums=donate,
-            ),
+            jax.jit(chunk_fn, donate_argnums=donate),
             key=f"serve.{seq}:prefill_chunk",
             name=f"serve.prefill_chunk[{self.name}]",
             kind="serve.step", sync=True, **mmeta,
@@ -423,6 +557,19 @@ class GenerationEngine:
         #: step in progress
         self._poison: Optional[BaseException] = None
         _m_pages_capacity.set(float(num_pages))
+        _m_tp_degree.set(float(self.tp_degree), engine=self.name)
+        #: estimated collective wall per dispatched step (0 solo): a
+        #: one-time micro-measurement of the step's gather pattern,
+        #: charged to serve.collective_seconds per dispatch
+        self._collective_step_s = 0.0
+        self._collective_bytes_per_step = 0.0
+        if mesh is not None and self.tp_degree > 1:
+            from .tp import estimate_collective_seconds
+
+            (
+                self._collective_step_s,
+                self._collective_bytes_per_step,
+            ) = estimate_collective_seconds(self, mesh, self._tp_axis)
 
     # -- tuned serving knobs ----------------------------------------------
 
@@ -458,6 +605,28 @@ class GenerationEngine:
             )
         except Exception:
             return hint
+
+    def _tuned_page_slots(self, kv_dtype, head_dim: int):
+        """The autotuner's ``serve.page_slots`` winner — pool geometry
+        (decode slots × pages per slot) — for this model signature, or
+        None when nothing is stored. Cache-only at init, like the other
+        serving knobs (the measured search lives in
+        ``tune.tune_serve_knobs``)."""
+        try:
+            from .. import tune
+
+            if tune.mode() == "off":
+                return None
+            win = tune.lookup(
+                "serve.page_slots",
+                tune.serve_signature(
+                    kv_dtype, head_dim, self.max_seq_len
+                ),
+                {},
+            )
+            return win or None
+        except Exception:
+            return None
 
     def _tuned_prefill_chunk(self, kv_dtype, head_dim: int) -> int:
         """The autotuner's ``serve.prefill_chunk`` winner (0 — whole
@@ -636,6 +805,12 @@ class GenerationEngine:
             return state[0], state[1], nxt
 
         return decode
+
+    def _charge_collectives(self) -> None:
+        """One step program dispatched: charge its estimated collective
+        wall (no-op solo)."""
+        if self._collective_step_s:
+            _m_collective_s.inc(self._collective_step_s)
 
     def _record_program(self, name: str, *args) -> None:
         sig: List = [name]
@@ -958,8 +1133,8 @@ class GenerationEngine:
         src = act.cow_src
         dst = act.seq.pages[act.cached_tokens // self.page_size]
         pool = self.pool
-        pool.k = pool.k.at[:, dst].set(pool.k[:, src])
-        pool.v = pool.v.at[:, dst].set(pool.v[:, src])
+        pool.k = pool.place(pool.k.at[:, dst].set(pool.k[:, src]))
+        pool.v = pool.place(pool.v.at[:, dst].set(pool.v[:, src]))
         act.cow_src = None
         pool.free([src])
 
@@ -1017,6 +1192,7 @@ class GenerationEngine:
                 dispatch,
                 what=f"serve.prefill_chunk request {req.request_id}",
             )
+        self._charge_collectives()
         timings = req.handle.timings
         timings["prefill_s"] = (
             timings.get("prefill_s", 0.0) + time.perf_counter() - t0
@@ -1067,6 +1243,7 @@ class GenerationEngine:
             pool.k, pool.v, tok = run_with_retries(
                 dispatch, what=f"serve.prefill request {req.request_id}"
             )
+        self._charge_collectives()
         timings = req.handle.timings
         timings["prefill_s"] = (
             timings.get("prefill_s", 0.0) + time.perf_counter() - t0
@@ -1111,6 +1288,7 @@ class GenerationEngine:
             pool.k, pool.v, nxt = run_with_retries(
                 dispatch, what="serve.decode_step"
             )
+        self._charge_collectives()
         nxt = np.asarray(nxt)
         for idx, act in ready:
             self._emit(idx, act, int(nxt[idx]))
@@ -1281,6 +1459,32 @@ class GenerationEngine:
             # probe shows what this engine actually runs with
             "page_size": self.page_size,
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            # tensor parallelism (serve/tp.py): degree 1 = solo. Under
+            # TP each page spans the shards, so "per shard" pages equal
+            # the pool's logical counts while the BYTES per chip are
+            # the pool's divided by the degree — the capacity-scaling
+            # view operators size HBM with (ISSUE 14)
+            "tp_degree": self.tp_degree,
+            "tp": (
+                None
+                if self.mesh is None
+                else {
+                    "degree": self.tp_degree,
+                    "axis": self._tp_axis,
+                    "pages_capacity": self.pool.num_pages,
+                    "pages_in_use_per_shard": self.pool.pages_in_use,
+                    "kv_bytes_per_shard": int(
+                        (self.pool.k.nbytes + self.pool.v.nbytes)
+                        // max(1, self.tp_degree)
+                    ),
+                    "collective_seconds_per_step_est": round(
+                        self._collective_step_s, 6
+                    ),
+                    "collective_bytes_per_step_est": int(
+                        self._collective_bytes_per_step
+                    ),
+                }
+            ),
             "prefix_cache": (
                 self.prefix_cache.stats()
                 if self.prefix_cache is not None

@@ -279,7 +279,13 @@ class Fleet:
       error — the fleet's version of the fail-fast rule that a doomed
       stream's consumer must never hang to its own timeout;
     - ``auto_restart`` — False leaves fenced replicas down until a
-      caller restarts + probes them (``restart_replica()``).
+      caller restarts + probes them (``restart_replica()``);
+    - ``replica_kwargs`` — per-replica engine-kwarg overrides (one dict
+      per replica, merged over the shared kwargs). The tensor-parallel
+      door: replicas of different TP degree (``mesh=...``) coexist
+      behind one router, and failover replay ACROSS degrees stays
+      byte-identical because every degree emits the same bytes
+      (``serve/tp.py``).
     """
 
     def __init__(
@@ -293,17 +299,58 @@ class Fleet:
         max_replays: int = 8,
         failover_timeout_s: float = 60.0,
         auto_restart: bool = True,
+        replica_kwargs: Optional[Sequence[Dict]] = None,
         **engine_kwargs,
     ):
         if replicas < 1:
             raise ValueError(f"need replicas >= 1; got {replicas}")
+        if replica_kwargs is not None:
+            if len(replica_kwargs) != replicas:
+                raise ValueError(
+                    f"replica_kwargs has {len(replica_kwargs)} entries "
+                    f"for {replicas} replicas — one override dict per "
+                    f"replica"
+                )
+            for i, kw in enumerate(replica_kwargs):
+                reserved = {"name", "model"} & set(kw)
+                if reserved:
+                    # replica names are fleet-owned (the cost registry
+                    # and /statusz key on them) and the model is the
+                    # positional argument — a collision would surface
+                    # as an opaque TypeError from engine construction
+                    raise ValueError(
+                        f"replica_kwargs[{i}] overrides fleet-owned "
+                        f"key(s) {sorted(reserved)}; replica names are "
+                        f"assigned by the fleet and the model is shared"
+                    )
         # replica names flow into each engine so the per-program cost
         # registry (obs/programs.py) and /statusz attribute every step
-        # program to its replica (serve.decode[r1], ...)
+        # program to its replica (serve.decode[r1], ...).
+        #
+        # ``replica_kwargs`` overlays PER-REPLICA engine kwargs on the
+        # shared ones — the heterogeneous-fleet door: replicas of
+        # DIFFERENT tensor-parallel degree (``mesh=...``) behind one
+        # router. Byte-identity makes that safe: every TP degree emits
+        # the same bytes for the same request (serve/tp.py), so failover
+        # replay across degrees stays invisible to the stream exactly
+        # like same-shape failover. Overrides that change emitted
+        # streams (the model, top_k, eos_id) are the caller's contract
+        # to keep identical, as ever.
         self._replicas: List[_Replica] = [
             _Replica(
                 f"r{i}",
-                GenerationEngine(model, name=f"r{i}", **engine_kwargs),
+                GenerationEngine(
+                    model,
+                    name=f"r{i}",
+                    **{
+                        **engine_kwargs,
+                        **(
+                            replica_kwargs[i]
+                            if replica_kwargs is not None
+                            else {}
+                        ),
+                    },
+                ),
             )
             for i in range(int(replicas))
         ]

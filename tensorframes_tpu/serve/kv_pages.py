@@ -76,6 +76,7 @@ class PagePool:
         num_pages: int,
         page_size: int,
         dtype=None,
+        sharding=None,
     ):
         import jax.numpy as jnp
 
@@ -89,6 +90,14 @@ class PagePool:
         self.head_dim = int(head_dim)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
+        #: optional jax sharding pinning the KV-HEAD axis across a
+        #: device mesh (tensor-parallel serving, ``serve/tp.py``): each
+        #: chip holds its slice of every page, so one page costs
+        #: 1/N of its solo bytes per chip and a fixed per-chip HBM
+        #: budget holds N× the pages — the aggregate-capacity unlock.
+        #: Page BOOKKEEPING (free list, refcounts, tables) is untouched:
+        #: a page is still one logical unit spanning all shards.
+        self.sharding = sharding
         #: index of the trash page (valid to write, never read unmasked)
         self.trash_page = self.num_pages
         shape = (
@@ -99,8 +108,8 @@ class PagePool:
             self.head_dim,
         )
         dtype = jnp.float32 if dtype is None else dtype
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        self.k = self.place(jnp.zeros(shape, dtype))
+        self.v = self.place(jnp.zeros(shape, dtype))
         self._lock = threading.Lock()
         # LIFO free list: recently-freed pages are reused first (their
         # contents are hottest in any cache hierarchy, and reuse keeps
@@ -113,6 +122,18 @@ class PagePool:
         #: page table or the prefix cache naming the same page), -1 per
         #: free(); the page returns to the free list at 0
         self._refcount = np.zeros(self.num_pages, np.int32)
+
+    def place(self, arr):
+        """Pin ``arr`` to the pool's sharding (identity when unsharded).
+        Every eager rewrite of the pool arrays — :meth:`reset`,
+        :meth:`defragment`, the engine's copy-on-write clone — runs
+        through this so the compiled step programs always receive
+        already-placed inputs instead of resharding on dispatch."""
+        if self.sharding is None:
+            return arr
+        import jax
+
+        return jax.device_put(arr, self.sharding)
 
     # -- allocation --------------------------------------------------------
 
@@ -203,8 +224,8 @@ class PagePool:
                 self.head_dim,
             )
             dtype = self.k.dtype
-            self.k = jnp.zeros(shape, dtype)
-            self.v = jnp.zeros(shape, dtype)
+            self.k = self.place(jnp.zeros(shape, dtype))
+            self.v = self.place(jnp.zeros(shape, dtype))
             self._free = list(range(self.num_pages - 1, -1, -1))
             self._free_set = set(self._free)
             self._refcount[:] = 0
@@ -253,8 +274,8 @@ class PagePool:
                 perm[new] = old
             perm[len(remap) : self.num_pages] = tail
             perm[self.num_pages] = self.trash_page
-            self.k = self.k[:, perm]
-            self.v = self.v[:, perm]
+            self.k = self.place(self.k[:, perm])
+            self.v = self.place(self.v[:, perm])
             self._refcount = self._refcount[perm[: self.num_pages]]
             for pages in all_lists:
                 pages[:] = [remap[p] for p in pages]

@@ -36,9 +36,11 @@ from .search import (
     Tuner,
     clear,
     in_trial,
+    jobs_signature,
     lookup,
     mode,
     pin,
+    rank_tp_layouts,
     render_table,
     reset,
     serve_signature,
@@ -57,10 +59,12 @@ __all__ = [
     "default_model",
     "device_kind",
     "in_trial",
+    "jobs_signature",
     "load_cost_records",
     "lookup",
     "mode",
     "pin",
+    "rank_tp_layouts",
     "render_table",
     "reset",
     "serve_signature",

@@ -50,9 +50,11 @@ from .store import TuneStore, device_kind, make_key
 __all__ = [
     "Tuner",
     "clear",
+    "jobs_signature",
     "lookup",
     "mode",
     "pin",
+    "rank_tp_layouts",
     "render_table",
     "reset",
     "snapshot",
@@ -290,8 +292,15 @@ class Tuner:
                 ranked = self.model().rank(rest, feats)
             else:
                 ranked = [(c, float("inf")) for c in rest]
+            import math
+
             for cand, pred in ranked[: max(0, top_k - 1)]:
-                predicted[len(candidates)] = pred
+                # feats-less searches (and candidates whose features
+                # raised) rank at +inf — that is "no prediction", not a
+                # prediction to hold the honesty histogram against
+                # (observing inf would poison the scrape's _sum forever)
+                if math.isfinite(pred):
+                    predicted[len(candidates)] = pred
                 candidates.append(cand)
             if feats is not None:
                 try:
@@ -541,6 +550,140 @@ def render_table() -> str:
 # ---------------------------------------------------------------------------
 
 
+def jobs_signature() -> str:
+    """The distributed-job knob signature. Lease TTL trades liveness
+    (how fast a dead worker's blocks reclaim) against safety margin for
+    slow-but-alive workers — a property of the HOST (filesystem
+    latency, scheduler jitter), not of any workload shape, so one row
+    per device kind (the store keys on device separately) is the right
+    granularity."""
+    return "host=v1"
+
+
+def rank_tp_layouts(
+    model,
+    *,
+    max_seq_len: int,
+    max_slots: int = 8,
+    degrees: Sequence[int] = (1, 2, 4, 8),
+    page_size: Optional[int] = None,
+    persist: bool = True,
+) -> List[Dict[str, Any]]:
+    """Rank tensor-parallel serving layouts for one model shape with
+    the learned cost model — the ``serve.tp_layout`` surface.
+
+    No engines are built: per candidate TP degree the decode step's
+    PER-CHIP features are derived analytically from the sharding plan
+    (``serve/tp.py``) — the paged attention read's bytes and FLOPs
+    scale 1/N (KV pool sharded on heads), dense projections stay
+    replicated, and the per-step weight + context gathers add their
+    ``(N-1)/N`` bytes — and
+    :meth:`~tensorframes_tpu.tune.model.CostModel.predict` turns them
+    into a predicted step wall. The model is the tuner's: ridge-fit
+    from the observatory's persisted ``programs.jsonl`` FLOP/byte/wall
+    records when enough exist (multi-device serve records sharpen it
+    every round), the analytic roofline prior otherwise.
+
+    Returns ``[{"tp": N, "predicted_step_s": ..., "flops": ...,
+    "bytes": ...}, ...]`` cheapest-predicted first, and (with
+    ``persist`` and tuning not ``off``) pins the winner under
+    ``serve.tp_layout`` so benches, ``/statusz``, and operators read
+    one store row instead of re-deriving it."""
+    import numpy as np
+
+    from ..models.transformer import _kv_heads
+    from ..ops.attention import paged_page_size_hint
+
+    params = getattr(model, "params", model)
+    n_heads = params["n_heads"]
+    d_model = int(np.shape(params["embed"])[1])
+    vocab = int(np.shape(params["embed"])[0])
+    hd = d_model // n_heads
+    n_kv = _kv_heads(params["blocks"][0], d_model, n_heads)
+    n_layers = len(params["blocks"])
+    blk0 = params["blocks"][0]
+    d_ff = int(np.shape(blk0["up"])[1]) if "up" in blk0 else 0
+    kv_d = n_kv * hd
+    dtype = np.dtype(getattr(params["embed"], "dtype", np.float32))
+    itemsize = dtype.itemsize
+    ps = page_size or max(
+        1, min(int(paged_page_size_hint(dtype, hd)), max_seq_len)
+    )
+    t = -(-int(max_seq_len) // ps) * ps  # gather span per slot
+    s = int(max_slots)
+    w_layer = (
+        d_model * (d_model + 2 * kv_d)  # qkv
+        + d_model * d_model             # proj
+        + 2 * d_model * d_ff            # up + down
+    ) * itemsize
+
+    def feats(cand: Dict[str, Any]):
+        n = int(cand["tp"])
+        if n < 1 or n_kv % n or n_heads % n or (d_ff and d_ff % n):
+            raise ValueError(f"tp={n} does not divide the model")
+        kloc = n_kv // n
+        group = n_heads // n_kv
+        # paged read per chip: both gathered copies cross HBM, local
+        # heads only; scores + weighted sum per local head
+        att_bytes = 2.0 * n_layers * s * t * kloc * hd * itemsize
+        att_flops = 4.0 * n_layers * s * t * kloc * group * hd
+        # dense walk replicated at full shape (weights re-read per step)
+        dense_flops = 2.0 * s * (
+            n_layers * (
+                d_model * (d_model + 2 * kv_d)
+                + d_model * d_model
+                + 2 * d_model * d_ff
+            )
+            + d_model * vocab
+        )
+        dense_bytes = float(
+            n_layers * w_layer + vocab * d_model * itemsize
+        )
+        # the byte-identity plan's collectives: weight shards gathered
+        # to full + one per-layer context gather, (n-1)/n received
+        frac = (n - 1) / n
+        gather_bytes = frac * (
+            n_layers * w_layer + n_layers * s * d_model * itemsize
+        )
+        return (
+            att_flops + dense_flops,
+            att_bytes + dense_bytes + gather_bytes,
+            1.0,
+        )
+
+    # the layout winner depends on MODEL SIZE, not just the serving
+    # signature (a shallow toy model and a deep production model with
+    # the same dtype/head_dim/seq bucket want different degrees) —
+    # extend the key with every feature the prediction reads so they
+    # never overwrite each other's store row
+    sig = (
+        serve_signature(dtype, hd, max_seq_len)
+        + f"|layers={n_layers}|dff={d_ff}|kv={n_kv}|V={vocab}"
+        + f"|slots={s}"
+    )
+    t_ = tuner()
+    cands = [{"tp": int(n)} for n in degrees]
+    ranked = t_.model().rank(cands, feats)
+    out = []
+    for cand, pred in ranked:
+        f, b, _ = (
+            feats(cand) if np.isfinite(pred) else (None, None, None)
+        )
+        out.append(
+            {
+                "tp": cand["tp"],
+                "predicted_step_s": pred,
+                "flops": f,
+                "bytes": b,
+            }
+        )
+    if persist and mode() != "off" and out and np.isfinite(
+        out[0]["predicted_step_s"]
+    ):
+        t_.pin("serve.tp_layout", sig, {"tp": out[0]["tp"]})
+    return out
+
+
 def serve_signature(dtype, head_dim: int, max_seq_len: int) -> str:
     """The serving-knob signature: pool dtype kind, head dim, and the
     pow2 sequence bucket — what the page-size/prefill winners key on
@@ -562,25 +705,34 @@ def tune_serve_knobs(
     max_slots: int = 4,
     page_sizes: Optional[Sequence[int]] = None,
     prefill_chunks: Optional[Sequence[int]] = None,
+    page_slots: Optional[Sequence[Dict[str, int]]] = None,
     seed: int = 0,
     repeats: int = 1,
     budget_s: Optional[float] = None,
 ) -> Dict[str, Dict[str, Any]]:
-    """Measure and persist the serving knobs — page size and prefill
-    chunk tokens — for one model shape.
+    """Measure and persist the serving knobs — page size, prefill
+    chunk tokens, and the pool geometry (``serve.page_slots``: decode
+    slots × pages per slot) — for one model shape.
 
     Engine init consults the store only (building engines inside an
     engine's own constructor is not a sane trial), so the measured
-    search for these two surfaces lives here: each candidate builds a
+    search for these surfaces lives here: each candidate builds a
     throwaway :class:`~tensorframes_tpu.serve.GenerationEngine`, runs a
     seeded prompt batch through prefill + decode, and the median-wall
     winner is persisted for every later engine with this signature
     (``bench.py autotune`` and operators call this; byte-identity of
     the streams across every candidate is a serve-suite invariant —
-    page size and prefill chunking never change emitted tokens).
+    page size, chunking, slot count, and pool size never change
+    emitted tokens, only scheduling).
+
+    ``page_slots`` candidates are ``{"slots": S, "pages_per_slot": P}``
+    dicts (default: the full-coverage geometry plus a half-pool
+    oversubscription and a double-slot batch). Engines built with the
+    DEFAULT ``max_slots``/``num_pages`` pick the winner up from the
+    store; explicit arguments always win (docs/tuning.md).
 
     Returns ``{"serve.page_size": winner, "serve.prefill_chunk":
-    winner}``."""
+    winner, "serve.page_slots": winner}``."""
     import numpy as np
 
     from ..ops.attention import paged_page_size_hint
@@ -615,15 +767,30 @@ def tune_serve_knobs(
         for _ in range(max_slots)
     ]
 
-    def run_engine(page_size: int, chunk: int) -> None:
-        from ..serve import GenerationEngine
+    def run_engine(
+        page_size: int,
+        chunk: int,
+        slots: Optional[int] = None,
+        pages_per_slot: Optional[int] = None,
+    ) -> None:
+        from ..serve import GenerationEngine, pages_needed
 
+        slots = int(slots or max_slots)
+        num_pages = None
+        if pages_per_slot is not None:
+            # the feasibility floor: the pool must hold one full-length
+            # request even when the candidate oversubscribes
+            num_pages = max(
+                pages_needed(max_seq_len, int(page_size)),
+                slots * int(pages_per_slot),
+            )
         eng = GenerationEngine(
             model,
-            max_slots=max_slots,
+            max_slots=slots,
             page_size=int(page_size),
+            num_pages=num_pages,
             max_seq_len=max_seq_len,
-            queue_capacity=max_slots,
+            queue_capacity=max(slots, max_slots),
             prefill_chunk_tokens=int(chunk),
         )
         with eng:
@@ -653,4 +820,39 @@ def tune_serve_knobs(
         ),
         budget_s=budget_s, repeats=repeats,
     )
-    return {"serve.page_size": ps_winner, "serve.prefill_chunk": pc_winner}
+    best_ps = int(ps_winner.get("page_size", hint))
+    best_pc = int(pc_winner.get("tokens", 0))
+    from ..serve import pages_needed as _pages_needed
+
+    full_pps = _pages_needed(max_seq_len, best_ps)
+    geo_default = {"slots": int(max_slots), "pages_per_slot": full_pps}
+    if page_slots is None:
+        page_slots = [
+            geo_default,
+            # oversubscribe the pool: half the pages, lean on
+            # preempt-and-requeue (wins when live tokens << max length)
+            {
+                "slots": int(max_slots),
+                "pages_per_slot": max(1, full_pps // 2),
+            },
+            # widen the decode batch instead
+            {"slots": int(max_slots) * 2, "pages_per_slot": full_pps},
+        ]
+    geo_winner = t.lookup(
+        "serve.page_slots", sig, geo_default,
+        grid=[
+            {"slots": int(c["slots"]),
+             "pages_per_slot": int(c["pages_per_slot"])}
+            for c in page_slots
+        ],
+        trial=lambda cand: run_engine(
+            best_ps, best_pc,
+            slots=cand["slots"], pages_per_slot=cand["pages_per_slot"],
+        ),
+        budget_s=budget_s, repeats=repeats,
+    )
+    return {
+        "serve.page_size": ps_winner,
+        "serve.prefill_chunk": pc_winner,
+        "serve.page_slots": geo_winner,
+    }

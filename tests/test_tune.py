@@ -729,6 +729,103 @@ class TestByteIdentity:
             base_sampled,
         )
 
+    def test_serve_page_slots_geometry(self, tune_env, lm, monkeypatch):
+        """The ISSUE 14 pool-geometry surface: a stored winner steers
+        the DEFAULT max_slots and num_pages (clamped to feasibility),
+        explicit arguments always win, and the streams stay
+        byte-identical — geometry moves scheduling, never bytes."""
+        from tensorframes_tpu.serve import GenerationEngine
+
+        prompt = list(np.random.default_rng(5).integers(1, VOCAB, size=10))
+        monkeypatch.setenv("TFT_TUNE", "0")
+        base_eng = GenerationEngine(lm, max_seq_len=48, page_size=8)
+        assert base_eng.max_slots == 8  # the untuned default
+        base = base_eng.generate([prompt], 8)[0]
+        monkeypatch.delenv("TFT_TUNE")
+        set_config(autotune=True, tune_mode="cached")
+        sig = tune.serve_signature(np.float32, 4, 48)
+        tune.pin(
+            "serve.page_slots", sig, {"slots": 3, "pages_per_slot": 2}
+        )
+        eng = GenerationEngine(lm, max_seq_len=48, page_size=8)
+        assert eng.max_slots == 3
+        # pool = max(one full-length request, slots × pages_per_slot)
+        assert eng.pool.num_pages == max(eng._max_pages, 3 * 2)
+        np.testing.assert_array_equal(eng.generate([prompt], 8)[0], base)
+        # explicit arguments beat the winner
+        eng2 = GenerationEngine(
+            lm, max_seq_len=48, page_size=8, max_slots=5, num_pages=40
+        )
+        assert eng2.max_slots == 5 and eng2.pool.num_pages == 40
+        np.testing.assert_array_equal(eng2.generate([prompt], 8)[0], base)
+
+    def test_jobs_lease_ttl_surface(self, tune_env, tmp_path,
+                                    monkeypatch):
+        """The ISSUE 14 lease-TTL surface: cache/pin-only resolution on
+        the drain path, explicit ttl untouched, and a real one-worker
+        drain under the tuned TTL produces byte-identical block results
+        (TTL moves reclamation timing, never results)."""
+        from tensorframes_tpu.engine.dist_jobs import (
+            _tuned_lease_ttl,
+            run_worker,
+            wait_job,
+        )
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(48, 4)).astype(np.float32)
+        df = tft.TensorFrame.from_columns({"x": x}).analyze().repartition(2)
+
+        def fn(x):
+            return {"y": x * 3.0 + 1.0}
+
+        monkeypatch.setenv("TFT_TUNE", "0")
+        assert _tuned_lease_ttl(6.0) == 6.0
+        ref = np.asarray(tft.map_rows(fn, df).column_data("y").host())
+        monkeypatch.delenv("TFT_TUNE")
+        set_config(autotune=True, tune_mode="cached")
+        tune.pin("jobs.lease_ttl", tune.jobs_signature(), {"ttl_s": 2.0})
+        assert _tuned_lease_ttl(6.0) == 2.0
+        # garbage in the store degrades to the default, never a crash
+        tune.pin("jobs.lease_ttl", tune.jobs_signature(), {"ttl_s": -1})
+        assert _tuned_lease_ttl(6.0) == 6.0
+        tune.pin("jobs.lease_ttl", tune.jobs_signature(), {"ttl_s": 2.0})
+        path = str(tmp_path / "drain")
+        report = run_worker(
+            "map_rows", fn, df, path=path, worker_id="w0", poll_s=0.05
+        )
+        assert report.complete
+        out = wait_job(path, fn, df)
+        np.testing.assert_array_equal(
+            np.asarray(out.completed.column_data("y").host()), ref
+        )
+
+    def test_rank_tp_layouts_ranks_and_persists(self, tune_env, lm):
+        """The ISSUE 14 sharding-ranker surface: cost-model ranking over
+        TP degrees (programs.jsonl-fitted when records exist, analytic
+        prior otherwise), non-dividing degrees rank last with an
+        infinite prediction, winner persisted under serve.tp_layout."""
+        set_config(autotune=True, tune_mode="cached")
+        ranked = tune.rank_tp_layouts(
+            lm, max_seq_len=48, degrees=(1, 2, 4, 3)
+        )
+        assert [r["tp"] for r in ranked[:3]] != []
+        finite = [r for r in ranked if np.isfinite(r["predicted_step_s"])]
+        assert {r["tp"] for r in finite} == {1, 2, 4}
+        # n_heads=4 does not divide by 3 — ranked last, prediction inf
+        assert ranked[-1]["tp"] == 3
+        assert not np.isfinite(ranked[-1]["predicted_step_s"])
+        # predictions are monotone with the ranking order
+        preds = [r["predicted_step_s"] for r in ranked]
+        assert preds == sorted(preds)
+        stored = {
+            r["surface"]: r["config"] for r in tune.snapshot()
+        }
+        assert stored.get("serve.tp_layout", {}).get("tp") == finite[0]["tp"]
+        # higher degrees shrink the per-chip attention-read bytes the
+        # model sees (the 1/N KV sharding is IN the features)
+        by_tp = {r["tp"]: r for r in finite}
+        assert by_tp[4]["bytes"] < by_tp[2]["bytes"] < by_tp[1]["bytes"]
+
 
 # ---------------------------------------------------------------------------
 # persistence round-trip + mid-trial kill (real subprocesses)
@@ -888,7 +985,9 @@ class TestServeSatellites:
             max_slots=2, page_sizes=[8], prefill_chunks=[0, 8],
             repeats=1,
         )
-        assert set(winners) == {"serve.page_size", "serve.prefill_chunk"}
+        assert set(winners) == {
+            "serve.page_size", "serve.prefill_chunk", "serve.page_slots",
+        }
         stored = {
             r["surface"] for r in TuneStore(tune_env).entries().values()
         }
