@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan test-spec test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -84,6 +84,15 @@ test-plan:
 test-tune:
 	$(PY) -m pytest tests/ -q -m tune
 
+# the speculative-decoding suite (serve/engine.py draft + verify step
+# programs, the draft KV page group, exact-match acceptance): the
+# byte-identity matrix vs solo decode — greedy/seeded, chunked
+# prefill, prefix cache, preemption, restart, chaos at serve.verify,
+# fleet failover across different k — plus the adaptive-k controller.
+# Fast, CPU-only, deterministic; part of tier-1
+test-spec:
+	$(PY) -m pytest tests/ -q -m spec
+
 # the tensor-parallel serving suite (serve/tp.py: mesh-sharded step
 # programs + sharded KV PagePool — the TP=1/2/4 byte-identity matrix,
 # capacity scaling, hetero-TP fleet failover). Part of tier-1 (conftest
@@ -102,11 +111,14 @@ bench:
 	$(PY) bench.py
 
 # serving trajectory: tokens/s + inter-token latency at 1/4/16 concurrency,
-# the fleet's aggregate tokens/s at 1/2/4 replicas, and the
+# the fleet's aggregate tokens/s at 1/2/4 replicas, the
 # tensor-parallel axis — one replica spanning TP=1/2/4 simulated chips
-# with tok/s + aggregate KV pages per degree
-# (TFT_BENCH_REPLICAS=1,2 and TFT_BENCH_TP=1,2 shrink axes for smoke
-# runs; empty TFT_BENCH_TP disables the TP axis entirely)
+# with tok/s + aggregate KV pages per degree — and the speculative-
+# decoding axis (TFT_BENCH_SPEC, default 0,2,4: draft length k with
+# tok/s, inter-token p50/p99 and acceptance rate on a repeated-suffix
+# workload). (TFT_BENCH_REPLICAS=1,2, TFT_BENCH_TP=1,2 and
+# TFT_BENCH_SPEC=0,4 shrink axes for smoke runs; an empty value
+# disables that axis entirely)
 bench-serve:
 	$(PY) bench.py decode_serve
 

@@ -653,6 +653,44 @@ def main_decode_serve():
         tp_levels[str(d)] = _serve_tp_level(
             lm, d, plen=plen, max_new=max_new, seed=200 + d
         )
+    # the speculative-decoding axis (ISSUE 15): draft-length k = 0
+    # (plain decode) vs speculative k, tok/s + inter-token p50/p99 +
+    # measured acceptance rate, on a repeated-suffix smoke workload
+    # (prompts ending in a short repeating pattern — the regime
+    # speculation exists for). The draft is the TARGET's own weights
+    # (self-speculation), so acceptance ~1.0 and the numbers measure
+    # the mechanism's dispatch-amortization ceiling: k+1 tokens per
+    # draft+verify dispatch pair instead of 1 per decode dispatch; a
+    # real deployment's gain scales with its draft's acceptance, which
+    # this axis reports. TFT_BENCH_SPEC trims/extends the k list;
+    # empty disables the axis (the bench-check gate pins it off so the
+    # gated headline measures the unchanged k=0 path).
+    spec_env = os.environ.get("TFT_BENCH_SPEC", "0,2,4")
+    speculative = {}
+    if spec_env.strip():
+        rng_s = np.random.default_rng(15)
+        base = rng_s.integers(1, 256, size=8).astype(np.int32).tolist()
+        pattern = rng_s.integers(1, 256, size=4).astype(np.int32).tolist()
+        rep_prompts = [
+            (base + pattern * 6)[:plen] for _ in range(8)
+        ]
+        for k in [int(x) for x in spec_env.split(",") if x.strip()]:
+            kw = (
+                {}
+                if k == 0
+                else {"draft_params": lm.params, "draft_len": k}
+            )
+            stats = {}
+            res = _serve_one_concurrency(
+                lm, 8, plen=plen, max_new=48, seed=300 + k,
+                prompts=rep_prompts, stats_out=stats, **kw
+            )
+            spec = (stats.get("health") or {}).get("speculative")
+            res["acceptance_rate"] = (
+                spec["acceptance_rate"] if spec else None
+            )
+            res["draft_len"] = k
+            speculative[str(k)] = res
     # observability-cost axis (ISSUE 10): the same per-request shape
     # with tracing LIVE (JSONL sink attached — every span on the
     # prefill/decode path materializes and serializes) vs the TFT_OBS=0
@@ -683,6 +721,7 @@ def main_decode_serve():
                     "shared_prefix": shared_prefix,
                     "replicas": rep_levels,
                     "tensor_parallel": tp_levels,
+                    "speculative": speculative,
                     "observability": observability,
                     # a chaos-tainted number must never be mistaken for a
                     # clean one (the injection sites sit on this path; the

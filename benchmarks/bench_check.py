@@ -56,6 +56,11 @@ GATE_ENV = {
     # mesh engines compile three extra shard_map programs per degree —
     # trajectory material for `make bench-serve`, not gate material
     "TFT_BENCH_TP": "",
+    # the speculative-decoding axis (TFT_BENCH_SPEC, ISSUE 15) pinned
+    # OFF for the same reason: the gated headline measures the
+    # unchanged non-speculative (k=0) decode path; BASELINE.json notes
+    # the pin
+    "TFT_BENCH_SPEC": "",
     # the autotuner kill switch, pinned OFF: tuning trials (and a
     # winner that drifts between baseline recording and a later check)
     # must not pollute the regression baseline — the gate measures the

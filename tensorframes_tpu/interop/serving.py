@@ -676,11 +676,21 @@ class ScoringServer:
         dispatches, summed decode gaps, fleet replays)."""
         t = dict(handle.timings) if handle is not None else {}
         out: Dict[str, Any] = {"total_s": round(total_s, 6)}
-        for k in ("queue_wait_s", "prefill_s", "decode_s"):
+        # the speculative keys (draft/verify/rollback walls + the
+        # proposed/accepted/rolled-back counts) appear only when the
+        # engine actually speculated — a plain decode response carries
+        # the same payload it always did
+        for k in (
+            "queue_wait_s", "prefill_s", "decode_s",
+            "draft_s", "verify_s", "rollback_s",
+        ):
             if k in t:
                 out[k] = round(float(t[k]), 6)
         out["prefill_chunks"] = int(t.get("prefill_chunks", 0))
         out["replays"] = int(t.get("replays", 0))
+        for k in ("spec_proposed", "spec_accepted", "spec_rolled_back"):
+            if k in t:
+                out[k] = int(t[k])
         return out
 
     def _handle_generate(

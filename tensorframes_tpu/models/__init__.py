@@ -13,6 +13,7 @@ from .kmeans import kmeans, assign_clusters
 from .transformer import (
     TransformerLM,
     filter_logits,
+    init_draft_transformer,
     init_transformer,
     left_pad_prompts,
     transformer_generate,
@@ -28,6 +29,7 @@ __all__ = [
     "cnn_logits",
     "init_cnn",
     "TransformerLM",
+    "init_draft_transformer",
     "init_transformer",
     "transformer_generate",
     "transformer_logits",

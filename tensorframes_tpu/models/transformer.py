@@ -22,11 +22,13 @@ import numpy as np
 
 __all__ = [
     "init_transformer",
+    "init_draft_transformer",
     "transformer_logits",
     "transformer_generate",
     "transformer_step",
     "transformer_prefill",
     "transformer_prefill_chunk",
+    "transformer_verify_chunk",
     "transformer_tp_specs",
     "gather_tp_params",
     "transformer_loss",
@@ -109,6 +111,55 @@ def init_transformer(
             )
         params["blocks"].append(block)
     return params
+
+
+def init_draft_transformer(
+    target_params: Params,
+    seed: int,
+    *,
+    d_model: Optional[int] = None,
+    n_heads: Optional[int] = None,
+    n_layers: Optional[int] = None,
+    d_ff: Optional[int] = None,
+    n_kv_heads: Optional[int] = None,
+    dtype=None,
+) -> Params:
+    """A small DRAFT model for speculative decoding, derived from a
+    target model's params: same vocabulary and positional table (the
+    two properties the serving engine's draft/verify contract requires
+    — draft proposals are token ids in the target's vocab, and the
+    draft must reach every position the target can), smaller everything
+    else. Defaults: half the target's layers, the target's width/heads.
+    The draft is a plain :func:`init_transformer` model — train or
+    distill it like any other; the serving engine only needs the params
+    (``GenerationEngine(..., draft_params=...)``,
+    docs/serving_llm.md "Speculative decoding")."""
+    vocab = int(np.shape(target_params["embed"])[0])
+    tgt_d = int(np.shape(target_params["embed"])[1])
+    max_len = int(np.shape(target_params["pos"])[0])
+    tgt_heads = int(target_params["n_heads"])
+    d_model = tgt_d if d_model is None else int(d_model)
+    n_heads = tgt_heads if n_heads is None else int(n_heads)
+    n_layers = (
+        max(1, len(target_params["blocks"]) // 2)
+        if n_layers is None
+        else int(n_layers)
+    )
+    if dtype is None:
+        dtype = np.dtype(
+            getattr(target_params["embed"], "dtype", np.float32)
+        )
+    return init_transformer(
+        seed,
+        vocab,
+        d_model=d_model,
+        n_heads=n_heads,
+        n_layers=n_layers,
+        max_len=max_len,
+        d_ff=d_ff,
+        n_kv_heads=n_kv_heads,
+        dtype=dtype,
+    )
 
 
 def _ln(x, p):
@@ -493,19 +544,53 @@ def transformer_prefill_chunk(params, tokens, positions, attend,
     :func:`transformer_prefill`'s, so a prompt prefilled in chunks
     produces byte-identical k/v and logits to one dense pass. Returns
     logits ``[B, C, vocab]``."""
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray(tokens, dtype=jnp.int32)
+    embed = jnp.asarray(params["embed"])
+    posemb = jnp.asarray(params["pos"])
+    h = embed[tokens] + posemb[positions][None]
+    return _chunk_blocks(params, h, attend, moe_top_k)
+
+
+def transformer_verify_chunk(params, tokens, positions, attend,
+                             moe_top_k: int = 1):
+    """The batched mid-sequence VERIFY step — the serving engine's
+    speculative-decoding sibling of :func:`transformer_prefill_chunk`:
+    the same delegated ``[B, C]`` block walk, but ``positions`` is
+    ``[B, C]`` because every decode slot sits at its OWN absolute
+    offset (slot ``b``'s ``k + 1`` verify tokens start at that
+    sequence's pending position, not a shared chunk start). The per-row
+    math is token-local and shared with the chunk walk
+    (:func:`_chunk_blocks`), which is what makes a verify pass's
+    logits — and therefore the target tokens sampled from them —
+    byte-identical to the per-token decode step's at every position
+    (docs/serving_llm.md "Speculative decoding"). Returns logits
+    ``[B, C, vocab]``."""
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray(tokens, dtype=jnp.int32)
+    embed = jnp.asarray(params["embed"])
+    posemb = jnp.asarray(params["pos"])
+    h = embed[tokens] + posemb[positions]  # [B, C] positions -> [B, C, D]
+    return _chunk_blocks(params, h, attend, moe_top_k)
+
+
+def _chunk_blocks(params, h, attend, moe_top_k: int):
+    """The shared ``[B, C]`` delegated-attention block walk of the
+    chunk family (:func:`transformer_prefill_chunk` /
+    :func:`transformer_verify_chunk`) — one implementation so the
+    prefill-chunk and verify programs cannot drift apart."""
     import jax
     import jax.numpy as jnp
 
     from ..parallel.moe import moe_ffn
 
-    tokens = jnp.asarray(tokens, dtype=jnp.int32)
-    bsz, clen = tokens.shape
+    bsz, clen, _ = h.shape
     n_heads = params["n_heads"]
     embed = jnp.asarray(params["embed"])
-    posemb = jnp.asarray(params["pos"])
     d_model = embed.shape[1]
     hd = d_model // n_heads
-    h = embed[tokens] + posemb[positions][None]
     for li, block in enumerate(params["blocks"]):
         n_kv = _kv_heads(block, d_model, n_heads)
         group = n_heads // n_kv

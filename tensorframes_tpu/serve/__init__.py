@@ -6,7 +6,11 @@ requests with independent arrival times and lengths share one decode
 batch and one static page pool, with at most three compiled step
 programs for the whole lifetime (prefill + decode, plus the
 prefill-chunk program when chunked prefill / prefix-cache resume is in
-play). See ``docs/serving_llm.md``.
+play) — five with speculative decoding on (a draft model proposes k
+tokens per step from its own KV page group; one batched
+``[max_slots, k + 1]`` verify program accepts by exact match against
+the target's own sampled tokens, so streams stay byte-identical to
+non-speculative decode). See ``docs/serving_llm.md``.
 
 - :mod:`.kv_pages` — the paged KV cache (static pool + page tables,
   refcounted pages + the shared-prefix :class:`PrefixCache`)
@@ -19,7 +23,13 @@ play). See ``docs/serving_llm.md``.
 
 from .engine import EngineUnhealthyError, GenerationEngine
 from .fleet import Fleet, FleetHandle
-from .kv_pages import PagePool, PrefixCache, SequencePages, pages_needed
+from .kv_pages import (
+    PageGroup,
+    PagePool,
+    PrefixCache,
+    SequencePages,
+    pages_needed,
+)
 from .scheduler import GenerationHandle, GenRequest, QueueFullError, Scheduler
 
 __all__ = [
@@ -29,6 +39,7 @@ __all__ = [
     "GenerationEngine",
     "GenerationHandle",
     "GenRequest",
+    "PageGroup",
     "PagePool",
     "PrefixCache",
     "QueueFullError",

@@ -21,7 +21,16 @@ lifetime —
   through :func:`~tensorframes_tpu.models.transformer_prefill_chunk`,
   attending to the pages already written — long prompts prefill one
   chunk per step, interleaved with decode, and prefix-cache hits resume
-  after the cached span.
+  after the cached span;
+- with SPECULATIVE DECODING on (``draft_params=``), two more — a
+  **draft** program proposing up to k tokens per slot from the draft
+  model's own KV page group, and a **verify** ``[max_slots, k + 1]``
+  program (the mid-sequence sibling of the prefill chunk,
+  :func:`~tensorframes_tpu.models.transformer_verify_chunk`) scoring
+  every proposal against the target's paged KV in one dispatch, with
+  exact-match acceptance keeping streams byte-identical to solo decode
+  (the plain decode program stops dispatching; the budget becomes
+  <= 5). See docs/serving_llm.md "Speculative decoding".
 
 Every input shape is static (page tables are fixed-width, idle slots
 point at the trash page), so slot turnover, ragged lengths, and
@@ -66,6 +75,7 @@ from ..models.transformer import (
     transformer_prefill,
     transformer_prefill_chunk,
     transformer_step,
+    transformer_verify_chunk,
 )
 from ..obs import (
     current_trace as _current_trace,
@@ -83,6 +93,7 @@ from ..utils import chaos as _chaos
 from ..utils.config import get_config
 from ..utils.failures import (
     DeadlineExceededError,
+    PagePoolExhausted,
     first_line as _first_line,
     is_oom,
     is_transient,
@@ -175,6 +186,30 @@ _m_tp_degree = _gauge(
     "replica; 1 = solo single-chip serving), per engine",
     labels=("engine",),
 )
+_m_spec_proposed = _counter(
+    "serve.spec_proposed_total",
+    "Speculative draft tokens proposed to the verify pass "
+    "(docs/serving_llm.md 'Speculative decoding')",
+)
+_m_spec_accepted = _counter(
+    "serve.spec_accepted_total",
+    "Speculative draft tokens accepted by exact match against the "
+    "target's own sampled token (the byte-identity contract)",
+)
+_m_spec_accept_rate = _gauge(
+    "serve.spec_acceptance_rate",
+    "Cumulative speculative acceptance per engine: accepted / proposed "
+    "draft tokens (the draft-length controller's signal; absent until "
+    "the first proposal). Labeled like serve.tp_degree — fleets run "
+    "several speculative engines in one process, and an unlabeled "
+    "gauge would flap between replicas last-writer-wins",
+    labels=("engine",),
+)
+_m_verify_s = _histogram(
+    "serve.verify_seconds",
+    "Wall seconds per batched multi-token verify dispatch (the "
+    "[max_slots, k+1] step program)",
+)
 _m_collective_s = _counter(
     "serve.collective_seconds",
     "ESTIMATED wall seconds spent in cross-chip collectives by the "
@@ -211,6 +246,75 @@ def _fail_reason(e: BaseException) -> str:
     if is_transient(e):
         return "transient_exhausted"
     return "fatal"
+
+
+def _span_attend(state, ptabs, pos, pos_c, counts, ps, trash, mp,
+                 max_len):
+    """The shared ``[S, C]`` paged scatter+read attend of the
+    speculative programs — the verify step and the draft's phase-1
+    chunk use this ONE builder (the TP verify keeps its own body: head
+    slicing and the context gather differ materially): scatter the
+    whole span's k/v (positions past ``counts`` or the sequence bound
+    land in the trash page), then read each position's visible history
+    through the page table under the chunk family's mask. One
+    implementation so the mask/scatter the byte-identity contract
+    rides on cannot drift between the two programs. ``state`` is the
+    caller's two-element ``[k_pool, v_pool]`` list, threaded through
+    layer by layer."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.attention import _NEG_BIG
+
+    slots, c = pos.shape
+    offs = jnp.arange(c)
+
+    def attend(li, q, k, v):
+        valid = (offs[None, :] < counts[:, None]) & (pos < max_len)
+        page = jnp.where(
+            valid,
+            jnp.take_along_axis(ptabs, pos_c // ps, axis=1),
+            trash,
+        )
+        off = pos_c % ps
+        state[0] = state[0].at[li, page, off].set(k)
+        state[1] = state[1].at[li, page, off].set(v)
+        n_kv, hd = k.shape[2], k.shape[3]
+        t = mp * ps
+        kg = state[0][li][ptabs].reshape(slots, t, n_kv, hd)
+        vg = state[1][li][ptabs].reshape(slots, t, n_kv, hd)
+        scale = 1.0 / float(np.sqrt(hd))
+        s = jnp.einsum("sckgd,stkd->sckgt", q, kg) * scale
+        visible = jnp.arange(t)[None, None, :] <= pos_c[:, :, None]
+        s = jnp.where(visible[:, :, None, None, :], s, _NEG_BIG)
+        att = jnp.einsum(
+            "sckgt,stkd->sckgd", jax.nn.softmax(s, axis=-1), vg
+        )
+        return att.reshape(slots, c, n_kv * q.shape[3] * hd)
+
+    return attend
+
+
+def _sample_slot_tokens(logits, positions, temps, seeds, top_ps, top_k):
+    """THE per-row token rule, shared by the speculative draft and
+    verify programs: greedy argmax, or seeded categorical after
+    temperature + top-k/top-p filtering with the per-step key folded at
+    the row's ABSOLUTE position — line-for-line the decode program's
+    sampling (:meth:`GenerationEngine._decode_impl`), which is the
+    byte-identity contract: a verify row at position ``p`` draws
+    exactly the token solo decode would draw at ``p``. Traced inside
+    the compiled steps. ``logits`` [N, V]; everything else [N]."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1)
+    keys = jax.vmap(
+        lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
+    )(seeds, positions)
+    scaled = logits / jnp.maximum(temps[:, None], 1e-6)
+    filt = filter_logits(scaled, top_k=top_k, top_p=top_ps[:, None])
+    sampled = jax.vmap(jax.random.categorical)(keys, filt)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
 class GenerationEngine:
@@ -251,6 +355,27 @@ class GenerationEngine:
       (system prompts, few-shot templates) as refcounted KV pages with
       copy-on-write on in-page divergence; repeat prefixes skip their
       prefill entirely;
+    - ``draft_params``: a small DRAFT model of the same transformer
+      family (``TransformerLM`` or params dict; same vocabulary and a
+      positional table covering ``max_seq_len`` —
+      :func:`~tensorframes_tpu.models.init_draft_transformer` derives
+      one) turns on SPECULATIVE DECODING: each step the draft proposes
+      up to ``draft_len`` tokens from its own KV page group in the
+      pool, and ONE batched ``[max_slots, draft_len + 1]`` verify
+      program scores every proposal against the target's paged KV.
+      Acceptance is EXACT-MATCH against the target's own sampled token
+      (greedy or seeded), so emitted streams stay byte-identical to
+      non-speculative decode; rejected speculative KV rolls back by
+      length bookkeeping alone. Adds two compiled step programs
+      (draft + verify; the plain decode program never dispatches while
+      speculation is on, so ``num_step_programs`` stays <= 5 — <= 3
+      with speculation off). See docs/serving_llm.md "Speculative
+      decoding";
+    - ``draft_len``: the compiled STATIC draft length k (default:
+      the autotuner's ``serve.draft_len`` winner, else 4). A per-slot
+      adaptive controller shrinks the effective k on cold
+      (low-acceptance) slots and grows it back on hot ones, bounded by
+      this static k;
     - ``mesh``: a 1-D :class:`jax.sharding.Mesh` makes THIS replica
       span its chips (tensor parallelism, ``serve/tp.py``): the same
       three step programs compile as ``jit(shard_map(...))`` — weights
@@ -266,7 +391,10 @@ class GenerationEngine:
 
     A third compiled program (the ``[1, chunk]`` prefill-chunk step)
     exists only when chunked prefill or the prefix cache dispatches it:
-    ``num_step_programs`` stays <= 2 with both off, <= 3 otherwise."""
+    ``num_step_programs`` stays <= 2 with both off, <= 3 otherwise.
+    Speculative decoding (``draft_params=``) adds the draft and verify
+    programs — and retires the plain decode dispatch while it is on —
+    so the budget becomes <= 5."""
 
     def __init__(
         self,
@@ -283,6 +411,8 @@ class GenerationEngine:
         attention_impl: Optional[str] = None,
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache: Optional[bool] = None,
+        draft_params=None,
+        draft_len: Optional[int] = None,
         name: Optional[str] = None,
         mesh=None,
     ):
@@ -441,6 +571,65 @@ class GenerationEngine:
         self.top_k = int(top_k)
         self.eos_id = eos_id
         self._d_model = d_model
+        # -- speculative decoding: the draft model's config + KV page
+        # group (docs/serving_llm.md "Speculative decoding") -----------
+        #: compiled static draft length k (0 = speculation off)
+        self.draft_len = 0
+        self._draft_dev = None
+        self._draft_group = None
+        self._draft_d_model = 0
+        #: cumulative host-side speculation stats (health()/statusz)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        if draft_params is not None:
+            dp = getattr(draft_params, "params", draft_params)
+            d_vocab = int(np.shape(dp["embed"])[0])
+            vocab = int(np.shape(params["embed"])[0])
+            if d_vocab != vocab:
+                raise ValueError(
+                    f"draft model vocabulary ({d_vocab}) must match the "
+                    f"target's ({vocab}): proposals are target token ids"
+                )
+            if int(np.shape(dp["pos"])[0]) < self.max_seq_len:
+                raise ValueError(
+                    f"draft model's positional table "
+                    f"({int(np.shape(dp['pos'])[0])}) is shorter than "
+                    f"max_seq_len ({self.max_seq_len})"
+                )
+            if draft_len is None:
+                draft_len = self._tuned_draft_len(kv_dtype, hd)
+            if int(draft_len) < 1:
+                raise ValueError(
+                    f"draft_len must be >= 1 with a draft model; got "
+                    f"{draft_len} (omit draft_params to disable "
+                    f"speculation)"
+                )
+            self.draft_len = min(int(draft_len), self.max_seq_len - 1)
+            d_heads = dp["n_heads"]
+            self._draft_d_model = int(np.shape(dp["embed"])[1])
+            d_hd = self._draft_d_model // d_heads
+            d_n_kv = _kv_heads(
+                dp["blocks"][0], self._draft_d_model, d_heads
+            )
+            # the draft's own KV page group: parallel page arrays in the
+            # SAME pool index space (one page list covers both models —
+            # alloc/free/defrag/prefix-sharing stay single-sourced).
+            # Replicated even under a TP mesh: the draft is small and
+            # its proposals never touch emitted bytes, so sharding it
+            # buys nothing the verify contract needs.
+            self._draft_group = self.pool.add_group(
+                "draft",
+                n_layers=len(dp["blocks"]),
+                n_kv_heads=d_n_kv,
+                head_dim=d_hd,
+                dtype=np.dtype(
+                    getattr(dp["embed"], "dtype", np.float32)
+                ),
+            )
+            self._draft_host = {
+                k: v for k, v in dp.items() if k != "n_heads"
+            }
+            self._draft_n_heads = d_heads
         # weights enter the compiled steps as an ARGUMENT (swap-safe, like
         # TransformerLM.generate); one device copy held for the lifetime.
         # Under tensor parallelism the copy is SHARDED AT REST per
@@ -506,10 +695,20 @@ class GenerationEngine:
             chunk_fn = _tp.tp_prefill_chunk_impl(
                 self, mesh, ax, n_heads, moe_top_k
             )
+            verify_fn = (
+                _tp.tp_verify_impl(self, mesh, ax, n_heads, moe_top_k)
+                if self.draft_len
+                else None
+            )
         else:
             prefill_fn = self._prefill_impl(n_heads, moe_top_k)
             decode_fn = self._decode_impl(n_heads, moe_top_k)
             chunk_fn = self._prefill_chunk_impl(n_heads, moe_top_k)
+            verify_fn = (
+                self._verify_impl(n_heads, moe_top_k)
+                if self.draft_len
+                else None
+            )
         self._prefill_jit = _programs.instrument(
             jax.jit(prefill_fn, donate_argnums=donate),
             key=f"serve.{seq}:prefill",
@@ -531,6 +730,32 @@ class GenerationEngine:
             name=f"serve.prefill_chunk[{self.name}]",
             kind="serve.step", sync=True, **mmeta,
         )
+        self._verify_jit = self._draft_jit = None
+        if self.draft_len:
+            # the two speculative programs (draft + verify). The DRAFT
+            # model runs replicated (plain jit) even under a mesh — its
+            # proposals steer how many positions the verify covers,
+            # never their values — while the VERIFY program shards on
+            # KV heads exactly like decode (serve/tp.py).
+            self._draft_dev = jax.device_put(self._draft_host)
+            del self._draft_host
+            self._verify_jit = _programs.instrument(
+                jax.jit(verify_fn, donate_argnums=donate),
+                key=f"serve.{seq}:verify",
+                name=f"serve.verify[{self.name}]",
+                kind="serve.step", sync=True,
+                draft_len=self.draft_len, **mmeta,
+            )
+            self._draft_jit = _programs.instrument(
+                jax.jit(
+                    self._draft_impl(self._draft_n_heads, moe_top_k),
+                    donate_argnums=donate,
+                ),
+                key=f"serve.{seq}:draft",
+                name=f"serve.draft[{self.name}]",
+                kind="serve.step", sync=True,
+                draft_len=self.draft_len, **mmeta,
+            )
         #: distinct (name, abstract input signature) pairs dispatched —
         #: jit keys compiles on exactly this, so its length IS the number
         #: of compiled step programs
@@ -627,6 +852,27 @@ class GenerationEngine:
             return win or None
         except Exception:
             return None
+
+    def _tuned_draft_len(self, kv_dtype, head_dim: int) -> int:
+        """Default static draft length k when ``draft_params`` is given
+        with no explicit ``draft_len``: the autotuner's
+        ``serve.draft_len`` winner for this model signature (the
+        measured search lives in ``tune.tune_serve_knobs``, driven by
+        the acceptance-rate and verify-wall series), else 4 — cache-only
+        at init like the other serving knobs."""
+        try:
+            from .. import tune
+
+            if tune.mode() == "off":
+                return 4
+            win = tune.lookup(
+                "serve.draft_len",
+                tune.serve_signature(kv_dtype, head_dim, self.max_seq_len),
+                {"k": 4},
+            )
+            return max(1, min(int(win.get("k", 4)), self.max_seq_len - 1))
+        except Exception:
+            return 4
 
     def _tuned_prefill_chunk(self, kv_dtype, head_dim: int) -> int:
         """The autotuner's ``serve.prefill_chunk`` winner (0 — whole
@@ -806,6 +1052,165 @@ class GenerationEngine:
 
         return decode
 
+    def _verify_impl(self, n_heads: int, moe_top_k: int):
+        """The VERIFY step — the engine's fourth compiled program, the
+        speculative-decoding tentpole: ``[max_slots, k + 1]`` tokens
+        (each slot's pending token followed by its draft proposals) run
+        the TARGET model's mid-sequence chunk walk
+        (:func:`transformer_verify_chunk`) in ONE dispatch, scattering
+        target k/v for every position and sampling the target's token
+        at each with the per-step key folded at that ABSOLUTE position
+        — exactly the decode program's rule, which is what keeps
+        speculative streams byte-identical to solo decode (greedy and
+        seeded). Positions past a slot's ``n_valid`` (adaptive k < the
+        static k, idle slots) scatter into the trash page and their
+        samples are ignored."""
+        import jax.numpy as jnp
+
+        ps = self.page_size
+        trash = self.pool.trash_page
+        top_k = self.top_k
+        mp = self._max_pages
+        max_len = self.max_seq_len
+        c = self.draft_len + 1
+
+        def verify(
+            p, kp, vp, toks, starts, n_valid, ptabs, temps, seeds, top_ps
+        ):
+            full = {**p, "n_heads": n_heads}
+            slots = toks.shape[0]
+            pos = starts[:, None] + jnp.arange(c)[None, :]  # [S, C]
+            pos_c = jnp.clip(pos, 0, max_len - 1)
+            state = [kp, vp]
+            # the shared span attend: scatter the whole verify span's
+            # k/v (padding and out-of-range positions land in the trash
+            # page), then read each position's visible history through
+            # the page table — the prefill-chunk read, batched over
+            # slots
+            attend = _span_attend(
+                state, ptabs, pos, pos_c, n_valid, ps, trash, mp,
+                max_len,
+            )
+            logits = transformer_verify_chunk(
+                full, toks, pos_c, attend, moe_top_k=moe_top_k
+            )  # [S, C, V]
+            vocab = logits.shape[-1]
+            u = _sample_slot_tokens(
+                logits.reshape(slots * c, vocab),
+                pos_c.reshape(-1),
+                jnp.repeat(temps, c),
+                jnp.repeat(seeds, c),
+                jnp.repeat(top_ps, c),
+                top_k,
+            ).reshape(slots, c)
+            return state[0], state[1], u
+
+        return verify
+
+    def _draft_impl(self, n_heads: int, moe_top_k: int):
+        """The DRAFT step — one dispatch per engine step proposes up to
+        k tokens per slot from the draft model's own KV page group:
+
+        - phase 1 (chunk): the ``[max_slots, k + 1]`` context window —
+          tokens the draft has not ingested yet, teacher-forced —
+          runs the draft's chunk walk, writing draft k/v; the LAST
+          context token's logits seed proposal 1 (sampled with the
+          target's exact rule at that absolute position, so a correct
+          draft's proposal matches the target's token bit-for-bit);
+        - phase 2 (scan, k - 1 iterations): single-token draft steps
+          extend the proposals, each writing its draft k/v and sampling
+          the next.
+
+        The same program also serves CATCH-UP (a freshly prefilled
+        prompt, a preemption replay): the host feeds ONE lag window per
+        engine step through phase 1 — the slot decodes plainly until
+        the backlog drains, bounding the stall like chunked prefill —
+        and uses proposals only once the window reaches the newest
+        token. Proposals never touch emitted bytes — the verify
+        program's target tokens do — so the draft runs replicated even
+        under a TP mesh."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import paged_attention
+
+        ps = self.page_size
+        trash = self.pool.trash_page
+        top_k = self.top_k
+        mp = self._max_pages
+        max_len = self.max_seq_len
+        k_static = self.draft_len
+        w = k_static + 1
+        d_model = self._draft_d_model
+
+        def draft(
+            p, kp, vp, ctx, starts, n_ctx, ptabs, temps, seeds, top_ps
+        ):
+            full = {**p, "n_heads": n_heads}
+            slots = ctx.shape[0]
+            pos = starts[:, None] + jnp.arange(w)[None, :]
+            pos_c = jnp.clip(pos, 0, max_len - 1)
+            state = [kp, vp]
+            attend = _span_attend(
+                state, ptabs, pos, pos_c, n_ctx, ps, trash, mp, max_len
+            )
+            logits = transformer_verify_chunk(
+                full, ctx, pos_c, attend, moe_top_k=moe_top_k
+            )  # [S, W, V]
+            last_pos = starts + n_ctx - 1
+            last = jnp.take_along_axis(
+                logits, (n_ctx - 1)[:, None, None], axis=1
+            )[:, 0]  # [S, V]
+            t1 = _sample_slot_tokens(
+                last,
+                jnp.clip(last_pos, 0, max_len - 1),
+                temps, seeds, top_ps, top_k,
+            )
+            if k_static == 1:
+                return state[0], state[1], t1[:, None]
+
+            def scan_body(carry, _):
+                dk, dv, tok, posn = carry
+                posn_c = jnp.clip(posn, 0, max_len - 1)
+                inner = [dk, dv]
+
+                def attend_step(li, q, k, v):
+                    page = jnp.where(
+                        posn < max_len,
+                        ptabs[jnp.arange(slots), posn_c // ps],
+                        trash,
+                    )
+                    off = posn_c % ps
+                    inner[0] = inner[0].at[li, page, off].set(k)
+                    inner[1] = inner[1].at[li, page, off].set(v)
+                    read = paged_attention(
+                        q, inner[0][li], inner[1][li], ptabs, posn_c + 1
+                    )
+                    return read.reshape(slots, d_model)
+
+                step_logits = transformer_step(
+                    full, tok, posn_c, attend_step, moe_top_k=moe_top_k
+                )
+                nxt = _sample_slot_tokens(
+                    step_logits, posn_c, temps, seeds, top_ps, top_k
+                )
+                return (inner[0], inner[1], nxt, posn + 1), nxt
+
+            # proposal t_i sits at absolute position last_pos + i; the
+            # scan walks t_1 .. t_{k-1} through the draft (writing their
+            # draft k/v — correct whenever the proposal is accepted) and
+            # emits t_2 .. t_k
+            (dk, dv, _, _), rest = jax.lax.scan(
+                scan_body,
+                (state[0], state[1], t1, last_pos + 1),
+                None,
+                length=k_static - 1,
+            )
+            props = jnp.concatenate([t1[:, None], rest.T], axis=1)
+            return dk, dv, props
+
+        return draft
+
     def _charge_collectives(self) -> None:
         """One step program dispatched: charge its estimated collective
         wall (no-op solo)."""
@@ -827,7 +1232,9 @@ class GenerationEngine:
         """Distinct compiled step programs dispatched so far (jit keys on
         the abstract input signature; static shapes keep this at <= 3:
         one prefill + one decode, plus the prefill-chunk program when
-        chunked prefill / prefix-cache resume dispatches it)."""
+        chunked prefill / prefix-cache resume dispatches it — and <= 5
+        with speculative decoding on, which adds the draft and verify
+        programs while the plain decode program stops dispatching)."""
         return len(self.program_signatures)
 
     # -- submission --------------------------------------------------------
@@ -998,7 +1405,10 @@ class GenerationEngine:
             ]
             if ready:
                 try:
-                    self._decode_batch(ready)
+                    if self.draft_len:
+                        self._spec_batch(ready)
+                    else:
+                        self._decode_batch(ready)
                     self._consecutive_ooms = 0
                 except Exception as e:
                     if is_oom(e) and self._recover_oom():
@@ -1111,6 +1521,13 @@ class GenerationEngine:
             if act.cached_tokens > 0:
                 _m_prefix_hits.inc()
                 _m_prefix_tokens_saved.inc(act.cached_tokens)
+        if self.draft_len and act.cached_tokens > 0:
+            # shared prefix pages carry the donor's DRAFT-KV rows too
+            # (same page indices in the draft group), so the draft skips
+            # the cached span exactly like the target prefill does; a
+            # donor that never caught up leaves zeroed rows — proposals
+            # degrade, the verify pass still decides every byte
+            act.draft_pos = act.cached_tokens
         chunking = self.prefill_chunk_tokens > 0
         if act.cached_tokens > 0 or (
             chunking and plen > self.prefill_chunk_tokens
@@ -1135,6 +1552,13 @@ class GenerationEngine:
         pool = self.pool
         pool.k = pool.place(pool.k.at[:, dst].set(pool.k[:, src]))
         pool.v = pool.place(pool.v.at[:, dst].set(pool.v[:, src]))
+        for g in pool.groups.values():
+            # the donor's draft-KV rows ride the same page indices: the
+            # clone must carry them too, or the sharer's draft would
+            # propose from a zeroed page (correctness is unaffected —
+            # verify decides — but the acceptance rate would crater)
+            g.k = g.place(g.k.at[:, dst].set(g.k[:, src]))
+            g.v = g.place(g.v.at[:, dst].set(g.v[:, src]))
         act.cow_src = None
         pool.free([src])
 
@@ -1292,6 +1716,240 @@ class GenerationEngine:
         nxt = np.asarray(nxt)
         for idx, act in ready:
             self._emit(idx, act, int(nxt[idx]))
+
+    # -- speculative decoding ---------------------------------------------
+
+    def _spec_slot_k(self, act: _Active) -> int:
+        """This step's EFFECTIVE draft length for one slot: the per-slot
+        adaptive k (seeded from the compiled static k), clamped so the
+        verify span never outruns the sequence bound or the request's
+        remaining budget, then clamped to the pages actually granted —
+        speculation degrades to a shorter k under pool pressure, it
+        never preempts live work for lookahead room."""
+        if act.spec_k < 0:
+            act.spec_k = self.draft_len
+        k = min(
+            act.spec_k,
+            self.draft_len,
+            act.remaining - 1,
+            self.max_seq_len - act.length,
+        )
+        k = max(0, k)
+        if k > 0:
+            try:
+                act.seq.ensure(act.length + k)
+            except PagePoolExhausted:
+                k = max(0, act.seq.capacity - act.length)
+        return k
+
+    def _draft_advance(self, ready: List[Tuple[int, _Active]]):
+        """ONE draft dispatch per engine step: each slot ingests its
+        next ``k + 1``-token window of un-ingested tokens (positions
+        ``draft_pos .. length - 1``, teacher-forced) through phase 1.
+        Slots whose window reaches the newest token are CAUGHT UP —
+        their proposals are live this step; slots still lagging (a
+        fresh long prefill, a preemption replay) advance one window per
+        step and decode plainly meanwhile, exactly the bounded-stall
+        discipline chunked prefill established: catch-up never turns
+        one engine step into O(prompt / k) back-to-back dispatches that
+        would spike every concurrent stream's inter-token latency.
+        Returns ``({slot: [k] proposals}, caught_up_slots)``."""
+        s = self.max_slots
+        w = self.draft_len + 1
+        g = self._draft_group
+        mp = self._max_pages
+        trash = self.pool.trash_page
+        ctx = np.zeros((s, w), np.int32)
+        starts = np.zeros(s, np.int32)
+        n_ctx = np.ones(s, np.int32)
+        ptabs = np.full((s, mp), trash, np.int32)
+        temps = np.zeros(s, np.float32)
+        seeds = np.zeros(s, np.int32)
+        top_ps = np.ones(s, np.float32)
+        caught_up: set = set()
+        for idx, act in ready:
+            l = act.length
+            if act.draft_pos >= l:
+                # caught up: re-ingest the newest token (rewrites
+                # identical draft k/v) so phase 1 seeds proposals
+                # from its logits
+                act.draft_pos = l - 1
+            lag = l - act.draft_pos
+            n = min(lag, w)
+            if lag <= w:
+                caught_up.add(idx)
+            start = act.draft_pos
+            # slice just the window (positions start .. start+n-1) out
+            # of prompt/generated — materializing the whole sequence
+            # here would put O(length) host copies per slot on every
+            # step's inter-token critical path
+            end = start + n
+            plen = len(act.req.prompt)
+            window: List[np.ndarray] = []
+            if start < plen:
+                window.append(act.req.prompt[start : min(end, plen)])
+            if end > plen:
+                window.append(
+                    np.asarray(
+                        act.generated[max(0, start - plen) : end - plen],
+                        np.int32,
+                    )
+                )
+            ctx[idx, :n] = (
+                window[0]
+                if len(window) == 1
+                else np.concatenate(window)
+            )
+            starts[idx] = start
+            n_ctx[idx] = n
+            ptabs[idx] = act.seq.table(mp)
+            temps[idx] = act.req.temperature
+            seeds[idx] = act.req.seed
+            top_ps[idx] = act.req.top_p
+        args = (ctx, starts, n_ctx, ptabs, temps, seeds, top_ps)
+        self._record_program("draft", self._draft_dev, g.k, *args)
+
+        def dispatch():
+            import jax
+
+            return jax.block_until_ready(
+                self._draft_jit(self._draft_dev, g.k, g.v, *args)
+            )
+
+        with _span("serve.draft", occupancy=len(ready)):
+            g.k, g.v, out = run_with_retries(
+                dispatch, what="serve.draft"
+            )
+        # no _charge_collectives: the draft program is replicated —
+        # it runs no cross-chip gathers even under a TP mesh
+        for idx, act in ready:
+            act.draft_pos = int(starts[idx]) + int(n_ctx[idx])
+        props = np.asarray(out)
+        return {idx: props[idx] for idx, _ in ready}, caught_up
+
+    def _spec_batch(self, ready: List[Tuple[int, _Active]]) -> None:
+        """One SPECULATIVE step for the decode batch: draft proposals,
+        one batched ``[max_slots, k + 1]`` verify dispatch, exact-match
+        acceptance. Every emitted token is the TARGET's own sampled
+        token (the verify program applies the decode rule at each
+        absolute position), so streams stay byte-identical to solo
+        non-speculative decode; the draft only decides how many
+        positions one dispatch covers. Rejected speculative KV rolls
+        back via length bookkeeping alone — positions past the accepted
+        length are never read before the next step overwrites them."""
+        s = self.max_slots
+        kmax = self.draft_len
+        c = kmax + 1
+        t_draft0 = time.perf_counter()
+        k_eff = {idx: self._spec_slot_k(act) for idx, act in ready}
+        proposals, caught_up = self._draft_advance(ready)
+        for idx, _ in ready:
+            if idx not in caught_up:
+                # the draft is still windowing this slot's backlog
+                # (long prefill, preemption replay): decode plainly
+                # this step — its proposals are mid-catch-up garbage
+                k_eff[idx] = 0
+        draft_wall = time.perf_counter() - t_draft0
+        toks = np.zeros((s, c), np.int32)
+        starts = np.zeros(s, np.int32)
+        n_valid = np.ones(s, np.int32)
+        ptabs = np.full(
+            (s, self._max_pages), self.pool.trash_page, np.int32
+        )
+        temps = np.zeros(s, np.float32)
+        seeds = np.zeros(s, np.int32)
+        top_ps = np.ones(s, np.float32)
+        for idx, act in ready:
+            k = k_eff[idx]
+            toks[idx, 0] = act.generated[-1]
+            toks[idx, 1 : 1 + k] = proposals[idx][:k]
+            starts[idx] = act.length - 1  # the pending token's position
+            n_valid[idx] = k + 1
+            ptabs[idx] = act.seq.table(self._max_pages)
+            temps[idx] = act.req.temperature
+            seeds[idx] = act.req.seed
+            top_ps[idx] = act.req.top_p
+        args = (toks, starts, n_valid, ptabs, temps, seeds, top_ps)
+        pool = self.pool
+        self._record_program("verify", self._params_dev, pool.k, *args)
+
+        def dispatch():
+            import jax
+
+            _chaos.site("serve.verify")
+            return jax.block_until_ready(
+                self._verify_jit(self._params_dev, pool.k, pool.v, *args)
+            )
+
+        t0 = time.perf_counter()
+        with _span("serve.verify", occupancy=len(ready)):
+            pool.k, pool.v, u = run_with_retries(
+                dispatch, what="serve.verify"
+            )
+        verify_wall = time.perf_counter() - t0
+        _m_verify_s.observe(verify_wall)
+        self._charge_collectives()
+        u = np.asarray(u)
+        t_roll0 = time.perf_counter()
+        for idx, act in ready:
+            k = k_eff[idx]
+            target = u[idx]
+            prop = proposals[idx]
+            accept = 0
+            while accept < k and int(prop[accept]) == int(target[accept]):
+                accept += 1
+            l0 = act.length
+            if idx in caught_up:
+                # draft KV stands for the accepted proposals the scan
+                # wrote (t_1 .. t_{k-1}); everything past that rolls
+                # back by this counter alone. Lagging slots keep the
+                # window progress _draft_advance recorded instead.
+                act.draft_pos = l0 + min(accept, kmax - 1)
+            if act.spec_k < 0:
+                act.spec_k = kmax
+            if k > 0 and accept == k:
+                act.spec_k = min(kmax, act.spec_k + 1)  # hot: grow
+            elif k > 0 and accept * 2 < k:
+                act.spec_k = max(1, act.spec_k - 1)  # cold: shrink
+            self._spec_proposed += k
+            self._spec_accepted += accept
+            if k:
+                _m_spec_proposed.inc(k)
+            if accept:
+                _m_spec_accepted.inc(accept)
+            timings = act.req.handle.timings
+            timings["draft_s"] = (
+                timings.get("draft_s", 0.0) + draft_wall
+            )
+            timings["verify_s"] = (
+                timings.get("verify_s", 0.0) + verify_wall
+            )
+            timings["spec_proposed"] = (
+                timings.get("spec_proposed", 0) + k
+            )
+            timings["spec_accepted"] = (
+                timings.get("spec_accepted", 0) + accept
+            )
+            timings["spec_rolled_back"] = (
+                timings.get("spec_rolled_back", 0) + (k - accept)
+            )
+            # emit the target's tokens: the accepted run plus the
+            # correction/bonus token — u[accept] is what solo decode
+            # would have emitted at that position either way
+            for j in range(accept + 1):
+                self._emit(idx, act, int(target[j]))
+                if self.scheduler.slots[idx] is not act:
+                    break  # EOS or budget mid-burst: the rest is moot
+        roll_wall = time.perf_counter() - t_roll0
+        for idx, act in ready:
+            if self.scheduler.slots[idx] is act:
+                t = act.req.handle.timings
+                t["rollback_s"] = t.get("rollback_s", 0.0) + roll_wall
+        if self._spec_proposed:
+            _m_spec_accept_rate.set(
+                self._spec_accepted / self._spec_proposed,
+                engine=self.name,
+            )
 
     def _emit(self, idx: int, act: _Active, tok: int) -> None:
         now = time.monotonic()
@@ -1489,6 +2147,23 @@ class GenerationEngine:
                 self.prefix_cache.stats()
                 if self.prefix_cache is not None
                 else None
+            ),
+            # speculative decoding (docs/serving_llm.md): None with no
+            # draft model; the acceptance rate is the draft-length
+            # controller's signal and the tuning cookbook's first read
+            "speculative": (
+                None
+                if not self.draft_len
+                else {
+                    "draft_len": self.draft_len,
+                    "proposed": self._spec_proposed,
+                    "accepted": self._spec_accepted,
+                    "acceptance_rate": round(
+                        self._spec_accepted
+                        / max(1, self._spec_proposed),
+                        4,
+                    ),
+                }
             ),
             "stepping_thread_alive": (
                 thread.is_alive() if thread is not None else None

@@ -36,7 +36,12 @@ identical, so an infeasible request is infeasible everywhere). Replays
 are capped at ``max_replays`` per request so one poison request that
 deterministically kills its replica cannot churn the whole fleet
 forever. Static shapes mean failover adds **zero compiled programs**:
-every replica keeps its own ≤ 2 step programs for the fleet's lifetime.
+every replica keeps its own ≤ 3 step programs for the fleet's lifetime
+(≤ 5 with speculative decoding's draft + verify). Speculation composes
+with replay unchanged: the relay only ever carries ACCEPTED target
+tokens, so a failover folds them into the prompt exactly as today —
+and replicas of DIFFERENT draft length k (or none at all) stay
+byte-identical, since every k emits the target's own sampled tokens.
 
 Chaos sites (``utils/chaos.py``): ``fleet.place`` sits in the placement
 path (a ``transient`` there retries invisibly); ``fleet.replica_fault``
@@ -394,8 +399,8 @@ class Fleet:
 
     def program_counts(self) -> Dict[str, int]:
         """Compiled step programs per replica — the soak pins every value
-        at <= 2 (failover, fencing, restart, and probe are all
-        shape-static)."""
+        at <= 3 (<= 5 for speculative replicas); failover, fencing,
+        restart, and probe are all shape-static."""
         return {
             rep.name: rep.engine.num_step_programs for rep in self._replicas
         }

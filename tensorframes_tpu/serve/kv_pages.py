@@ -49,7 +49,13 @@ import numpy as np
 from ..utils import chaos as _chaos
 from ..utils.failures import PagePoolExhausted
 
-__all__ = ["PagePool", "PrefixCache", "SequencePages", "pages_needed"]
+__all__ = [
+    "PageGroup",
+    "PagePool",
+    "PrefixCache",
+    "SequencePages",
+    "pages_needed",
+]
 
 
 def pages_needed(tokens: int, page_size: int) -> int:
@@ -110,6 +116,12 @@ class PagePool:
         dtype = jnp.float32 if dtype is None else dtype
         self.k = self.place(jnp.zeros(shape, dtype))
         self.v = self.place(jnp.zeros(shape, dtype))
+        #: named parallel page-array families addressed by the SAME page
+        #: indices as ``k``/``v`` (:meth:`add_group`) — how a draft
+        #: model's KV rides the pool without its own allocator: one
+        #: logical page spans the main arrays AND every group's, so
+        #: alloc/free/refcount/defragment stay single-sourced here
+        self.groups: Dict[str, "PageGroup"] = {}
         self._lock = threading.Lock()
         # LIFO free list: recently-freed pages are reused first (their
         # contents are hottest in any cache hierarchy, and reuse keeps
@@ -134,6 +146,35 @@ class PagePool:
         import jax
 
         return jax.device_put(arr, self.sharding)
+
+    def add_group(
+        self,
+        name: str,
+        n_layers: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=None,
+        sharding=None,
+    ) -> "PageGroup":
+        """Attach a named PARALLEL page-array family (``[n_layers,
+        num_pages + 1, page_size, n_kv_heads, head_dim]``) addressed by
+        the same page indices as the pool's own ``k``/``v`` — the
+        speculative-decoding draft model's KV page group
+        (docs/serving_llm.md "Speculative decoding"). Page BOOKKEEPING
+        (free list, refcounts, tables) is untouched: a page is one
+        logical unit spanning the main arrays and every group, so a
+        sequence's single page list covers its target AND draft KV, and
+        shared-prefix pages dedup both at once. :meth:`defragment`
+        renumbers group contents with the same permutation;
+        :meth:`reset` re-zeros them."""
+        if name in self.groups:
+            raise ValueError(f"page group {name!r} already exists")
+        g = PageGroup(
+            self, n_layers, n_kv_heads, head_dim,
+            dtype=dtype, sharding=sharding,
+        )
+        self.groups[name] = g
+        return g
 
     # -- allocation --------------------------------------------------------
 
@@ -226,6 +267,8 @@ class PagePool:
             dtype = self.k.dtype
             self.k = self.place(jnp.zeros(shape, dtype))
             self.v = self.place(jnp.zeros(shape, dtype))
+            for g in self.groups.values():
+                g.reset()
             self._free = list(range(self.num_pages - 1, -1, -1))
             self._free_set = set(self._free)
             self._refcount[:] = 0
@@ -276,6 +319,12 @@ class PagePool:
             perm[self.num_pages] = self.trash_page
             self.k = self.place(self.k[:, perm])
             self.v = self.place(self.v[:, perm])
+            for g in self.groups.values():
+                # a page is one logical unit across every group: the
+                # draft KV rows move with the same permutation, so page
+                # lists stay valid for both models
+                g.k = g.place(g.k[:, perm])
+                g.v = g.place(g.v[:, perm])
             self._refcount = self._refcount[perm[: self.num_pages]]
             for pages in all_lists:
                 pages[:] = [remap[p] for p in pages]
@@ -288,6 +337,63 @@ class PagePool:
             f"PagePool(pages={self.num_pages}, page_size={self.page_size}, "
             f"in_use={self.pages_in_use})"
         )
+
+
+class PageGroup:
+    """One named parallel page-array family over a :class:`PagePool`'s
+    index space (:meth:`PagePool.add_group`): its own ``k``/``v`` device
+    arrays with the pool's ``num_pages + 1`` / ``page_size`` geometry
+    (trash row included) but its own layer/head/dim shape and dtype —
+    the speculative-decoding DRAFT model's KV. No allocator of its own:
+    page index ``p`` in a sequence's table names row ``p`` here exactly
+    as it does in the main arrays."""
+
+    def __init__(
+        self,
+        pool: "PagePool",
+        n_layers: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=None,
+        sharding=None,
+    ):
+        import jax.numpy as jnp
+
+        self.pool = pool
+        self.n_layers = int(n_layers)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.sharding = sharding
+        self._dtype = pool.k.dtype if dtype is None else dtype
+        self.k = self.place(jnp.zeros(self._shape(), self._dtype))
+        self.v = self.place(jnp.zeros(self._shape(), self._dtype))
+
+    def _shape(self):
+        return (
+            self.n_layers,
+            self.pool.num_pages + 1,
+            self.pool.page_size,
+            self.n_kv_heads,
+            self.head_dim,
+        )
+
+    def place(self, arr):
+        """Pin ``arr`` to this group's own sharding (identity when
+        unsharded — the draft group stays replicated even under a
+        tensor-parallel pool)."""
+        if self.sharding is None:
+            return arr
+        import jax
+
+        return jax.device_put(arr, self.sharding)
+
+    def reset(self) -> None:
+        """Fresh zeroed arrays (crash recovery, with
+        :meth:`PagePool.reset`)."""
+        import jax.numpy as jnp
+
+        self.k = self.place(jnp.zeros(self._shape(), self._dtype))
+        self.v = self.place(jnp.zeros(self._shape(), self._dtype))
 
 
 class SequencePages:

@@ -155,7 +155,7 @@ class _Active:
 
     __slots__ = (
         "req", "seq", "generated", "admit_order", "last_emit_t",
-        "prefill_pos", "cached_tokens", "cow_src",
+        "prefill_pos", "cached_tokens", "cow_src", "draft_pos", "spec_k",
     )
 
     def __init__(self, req: GenRequest, seq: SequencePages, admit_order: int):
@@ -178,6 +178,21 @@ class _Active:
         #: after cloning, finish/preempt drop it when the slot dies
         #: first
         self.cow_src: Optional[int] = None
+        #: SPECULATIVE-length bookkeeping (the engine's draft model,
+        #: docs/serving_llm.md "Speculative decoding"): positions whose
+        #: DRAFT-model KV is valid. Host state only — a preemption or
+        #: restart re-admits through a fresh ``_Active``, so rejected or
+        #: stale speculative draft KV "rolls back" by this counter (and
+        #: the page tables) resetting, never by undoing page writes. A
+        #: prefix-cache hit seeds it at ``cached_tokens`` (the shared
+        #: pages carry the donor's draft KV rows too).
+        self.draft_pos = 0
+        #: the per-slot ADAPTIVE draft length: -1 until the engine's
+        #: first speculative step seeds it from the compiled static k;
+        #: the controller shrinks it on cold (low-acceptance) slots and
+        #: grows it back on hot ones, bounded by the static k. Dies with
+        #: the slot like ``draft_pos``.
+        self.spec_k = -1
 
     @property
     def length(self) -> int:

@@ -2,10 +2,13 @@
 
 The solo :class:`~.engine.GenerationEngine` compiles three step programs
 (prefill ``[1, max_seq_len]``, prefill-chunk ``[1, C]``, decode
-``[max_slots]``) for one chip. This module builds the SAME three
-programs as ``jit(shard_map(...))`` over a 1-D device mesh (ROADMAP
-item 1a), so one replica's model weights and KV pool span ``N`` chips
-while keeping every contract solo serving established:
+``[max_slots]``) for one chip — plus the speculative VERIFY program
+(``[max_slots, k + 1]``) when a draft model is attached. This module
+builds the SAME programs as ``jit(shard_map(...))`` over a 1-D device
+mesh (ROADMAP item 1a), so one replica's model weights and KV pool span
+``N`` chips while keeping every contract solo serving established (the
+DRAFT program is deliberately not here: it runs replicated — its
+proposals steer how many positions verify covers, never their values):
 
 - **byte-identical decode streams at every TP degree** — greedy AND
   seeded. Float matmuls are not associative, so any plan that changes a
@@ -63,9 +66,9 @@ import numpy as np
 from ..models.transformer import (
     filter_logits,
     gather_tp_params,
-    transformer_prefill,
     transformer_prefill_chunk,
     transformer_step,
+    transformer_verify_chunk,
 )
 
 __all__ = [
@@ -74,6 +77,7 @@ __all__ = [
     "tp_kv_specs",
     "tp_prefill_chunk_impl",
     "tp_prefill_impl",
+    "tp_verify_impl",
     "validate_tp_mesh",
 ]
 
@@ -155,13 +159,23 @@ def _wrap(body, mesh, axis: str, param_specs, n_scalars: int):
 
 
 def tp_prefill_impl(engine, mesh, axis: str, n_heads: int, moe_top_k: int):
-    """The TP prefill ``[1, max_seq_len]`` body: the full causal pass
-    runs replicated (identical to solo — logits and k/v bit-for-bit),
-    and each shard scatters only ITS heads' k/v slice into its pool
-    shard. Sampling mirrors :meth:`GenerationEngine._prefill_impl`
-    exactly."""
+    """The TP prefill ``[1, max_seq_len]`` body with the ATTENTION
+    sharded along KV heads (ROADMAP 1 follow-on — it used to compute
+    full heads replicated, sharding only the KV scatter): the prompt
+    runs the delegated chunk walk at positions ``0 .. P-1``, and each
+    shard computes the dense causal attention for ITS head slice only —
+    the head axis is a pure batch axis in both einsums, so every local
+    head's scores/softmax/weighted-sum are bit-for-bit the solo
+    program's for that head, and the tiled all-gather reassembles the
+    solo context exactly. Per-chip prefill attention FLOPs and the
+    ``O(P^2)`` score matrix both scale ~1/N. The shard's own k/v slice
+    scatters straight into its pool shard (no full-head tensor is ever
+    materialized), and sampling mirrors
+    :meth:`GenerationEngine._prefill_impl` exactly."""
     import jax
     import jax.numpy as jnp
+
+    from ..ops.attention import _NEG_BIG
 
     ps = engine.page_size
     trash = engine.pool.trash_page
@@ -171,18 +185,38 @@ def tp_prefill_impl(engine, mesh, axis: str, n_heads: int, moe_top_k: int):
 
     def prefill(p_loc, kp, vp, prompt, length, ptab, temp, seed, top_p):
         full = {**gather_tp_params(p_loc, axis), "n_heads": n_heads}
-        logits, kc, vc = transformer_prefill(
-            full, prompt, moe_top_k=moe_top_k
+        plen = prompt.shape[1]
+        pos = jnp.arange(plen)
+        state = [kp, vp]
+
+        def attend(li, q, k, v):
+            # local heads only: q [1, P, n_kv, g, hd] -> [P, kloc, g,
+            # hd]; k/v [1, P, n_kv, hd] -> [P, kloc, hd]
+            ql = _local_heads(q[0], axis, kloc, 1)
+            kl = _local_heads(k[0], axis, kloc, 1)
+            vl = _local_heads(v[0], axis, kloc, 1)
+            page = jnp.where(pos < length, ptab[pos // ps], trash)
+            off = pos % ps
+            state[0] = state[0].at[li, page, off].set(kl)
+            state[1] = state[1].at[li, page, off].set(vl)
+            hd = kl.shape[2]
+            scale = 1.0 / float(np.sqrt(hd))
+            # dense causal attention WITHIN the prompt, local heads:
+            # the same einsum family as transformer_prefill's, minus
+            # its batch axis — per head, bit-exact
+            s = jnp.einsum("qkgd,tkd->kgqt", ql, kl) * scale
+            causal = pos[:, None] >= pos[None, :]
+            s = jnp.where(causal[None, None], s, _NEG_BIG)
+            att = jnp.einsum(
+                "kgqt,tkd->kgqd", jax.nn.softmax(s, axis=-1), vl
+            )
+            att = jax.lax.all_gather(att, axis, axis=0, tiled=True)
+            # [n_kv, g, P, hd] -> [1, P, n_kv * g * hd]
+            return att.transpose(2, 0, 1, 3).reshape(1, plen, -1)
+
+        logits = transformer_prefill_chunk(
+            full, prompt, pos, attend, moe_top_k=moe_top_k
         )
-        # [L, 1, n_kv, Pmax, hd] -> [L, Pmax, n_kv, hd], then THIS
-        # shard's head slice -> [L, Pmax, kloc, hd]
-        k_all = _local_heads(kc[:, 0].transpose(0, 2, 1, 3), axis, kloc, 2)
-        v_all = _local_heads(vc[:, 0].transpose(0, 2, 1, 3), axis, kloc, 2)
-        pos = jnp.arange(prompt.shape[1])
-        page = jnp.where(pos < length, ptab[pos // ps], trash)
-        off = pos % ps
-        kp = kp.at[:, page, off].set(k_all)
-        vp = vp.at[:, page, off].set(v_all)
         last = logits[0, length - 1]
         greedy = jnp.argmax(last, axis=-1)
         key = jax.random.fold_in(jax.random.PRNGKey(seed), length - 1)
@@ -192,7 +226,7 @@ def tp_prefill_impl(engine, mesh, axis: str, n_heads: int, moe_top_k: int):
         filt = filter_logits(scaled, top_k=top_k, top_p=top_p)
         sampled = jax.random.categorical(key, filt, axis=-1)[0]
         tok = jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
-        return kp, vp, tok
+        return state[0], state[1], tok
 
     return _wrap(prefill, mesh, axis, engine._tp_param_specs, 6)
 
@@ -269,6 +303,90 @@ def tp_prefill_chunk_impl(
         return state[0], state[1], tok
 
     return _wrap(chunk_step, mesh, axis, engine._tp_param_specs, 8)
+
+
+def tp_verify_impl(engine, mesh, axis: str, n_heads: int, moe_top_k: int):
+    """The TP VERIFY ``[max_slots, k + 1]`` body — speculative
+    decoding's batched multi-token check, sharded on KV heads exactly
+    like decode: each shard scatters its head slice of the whole verify
+    span into its pool shard, walks the per-slot paged history for its
+    heads only (the chunk read, batched over slots — bit-exact per
+    head), and all-gathers the context before the replicated residual
+    walk. Sampling runs on replicated logits with the per-step key
+    folded at each ABSOLUTE position, mirroring
+    :meth:`GenerationEngine._verify_impl` — so speculative streams stay
+    byte-identical to solo at every TP degree."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.attention import _NEG_BIG
+    from .engine import _sample_slot_tokens
+
+    ps = engine.page_size
+    trash = engine.pool.trash_page
+    top_k = engine.top_k
+    mp = engine._max_pages
+    max_len = engine.max_seq_len
+    c = engine.draft_len + 1
+    tp = int(mesh.devices.size)
+    kloc = engine.pool.n_kv_heads // tp
+
+    def verify(
+        p_loc, kp, vp, toks, starts, n_valid, ptabs, temps, seeds, top_ps
+    ):
+        full = {**gather_tp_params(p_loc, axis), "n_heads": n_heads}
+        slots = toks.shape[0]
+        offs = jnp.arange(c)
+        pos = starts[:, None] + offs[None, :]
+        pos_c = jnp.clip(pos, 0, max_len - 1)
+        state = [kp, vp]
+
+        def attend(li, q, k, v):
+            # local heads: q [S, C, n_kv, g, hd] -> [S, C, kloc, g,
+            # hd]; k/v -> [S, C, kloc, hd]
+            ql = _local_heads(q, axis, kloc, 2)
+            kl = _local_heads(k, axis, kloc, 2)
+            vl = _local_heads(v, axis, kloc, 2)
+            valid = (offs[None, :] < n_valid[:, None]) & (pos < max_len)
+            page = jnp.where(
+                valid,
+                jnp.take_along_axis(ptabs, pos_c // ps, axis=1),
+                trash,
+            )
+            off = pos_c % ps
+            state[0] = state[0].at[li, page, off].set(kl)
+            state[1] = state[1].at[li, page, off].set(vl)
+            hd = kl.shape[3]
+            t = mp * ps
+            kg = state[0][li][ptabs].reshape(slots, t, kloc, hd)
+            vg = state[1][li][ptabs].reshape(slots, t, kloc, hd)
+            scale = 1.0 / float(np.sqrt(hd))
+            s = jnp.einsum("sckgd,stkd->sckgt", ql, kg) * scale
+            visible = (
+                jnp.arange(t)[None, None, :] <= pos_c[:, :, None]
+            )
+            s = jnp.where(visible[:, :, None, None, :], s, _NEG_BIG)
+            att = jnp.einsum(
+                "sckgt,stkd->sckgd", jax.nn.softmax(s, axis=-1), vg
+            )
+            att = jax.lax.all_gather(att, axis, axis=2, tiled=True)
+            return att.reshape(slots, c, att.shape[2] * q.shape[3] * hd)
+
+        logits = transformer_verify_chunk(
+            full, toks, pos_c, attend, moe_top_k=moe_top_k
+        )
+        vocab = logits.shape[-1]
+        u = _sample_slot_tokens(
+            logits.reshape(slots * c, vocab),
+            pos_c.reshape(-1),
+            jnp.repeat(temps, c),
+            jnp.repeat(seeds, c),
+            jnp.repeat(top_ps, c),
+            top_k,
+        ).reshape(slots, c)
+        return state[0], state[1], u
+
+    return _wrap(verify, mesh, axis, engine._tp_param_specs, 7)
 
 
 def tp_decode_impl(engine, mesh, axis: str, n_heads: int, moe_top_k: int):

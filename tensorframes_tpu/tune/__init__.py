@@ -31,7 +31,12 @@ config runs, **never** what it computes — every tuned surface is
 byte-identity-tested against its static default. See docs/tuning.md.
 """
 
-from .model import CostModel, default_model, load_cost_records
+from .model import (
+    CostModel,
+    default_model,
+    load_cost_records,
+    per_chip_records,
+)
 from .search import (
     Tuner,
     clear,
@@ -63,6 +68,7 @@ __all__ = [
     "load_cost_records",
     "lookup",
     "mode",
+    "per_chip_records",
     "pin",
     "rank_tp_layouts",
     "render_table",

@@ -41,6 +41,7 @@ __all__ = [
     "CostModel",
     "default_model",
     "load_cost_records",
+    "per_chip_records",
 ]
 
 logger = get_logger("tune.model")
@@ -202,10 +203,48 @@ def load_cost_records(path: Optional[str] = None) -> List[Dict[str, Any]]:
     return rows
 
 
-def default_model(path: Optional[str] = None) -> CostModel:
+def per_chip_records(
+    records: Iterable[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Normalize MULTI-DEVICE program records to per-chip features so
+    mixed-degree ``programs.jsonl`` histories fit ONE coherent model.
+
+    A tensor-parallel step program's record (``meta.tp_degree = N`` —
+    the per-replica TP-named programs ``serve.decode[rX]`` etc. write
+    these) carries the WHOLE program's FLOP/byte estimate while its
+    measured wall is the per-step wall of N chips working concurrently;
+    feeding it into the ridge fit as-is teaches the model an N×-too-slow
+    rate. Dividing the features by the degree yields what ONE chip
+    computed/moved per dispatch — the same unit
+    :func:`~tensorframes_tpu.tune.search.rank_tp_layouts` builds its
+    candidate features in, which is what lets the layout ranker learn
+    from multi-device serving history instead of single-device-only
+    records. Single-device rows pass through unchanged."""
+    out: List[Dict[str, Any]] = []
+    for rec in records:
+        try:
+            meta = rec.get("meta") or {}
+            tp = int(meta.get("tp_degree") or 1)
+        except Exception:
+            tp = 1
+        if tp > 1:
+            rec = dict(rec)
+            if rec.get("flops"):
+                rec["flops"] = float(rec["flops"]) / tp
+            if rec.get("bytes"):
+                rec["bytes"] = float(rec["bytes"]) / tp
+        out.append(rec)
+    return out
+
+
+def default_model(
+    path: Optional[str] = None, per_chip: bool = False
+) -> CostModel:
     """The model the tuner uses: ridge-fit from this host's persisted
     program costs when enough records exist, else the analytic prior.
-    Never raises."""
+    ``per_chip=True`` normalizes multi-device records first
+    (:func:`per_chip_records`) — what the tensor-parallel layout ranker
+    wants. Never raises."""
     try:
         records = load_cost_records(path)
         # fold in the LIVE registry too: a fresh process that has
@@ -217,6 +256,8 @@ def default_model(path: Optional[str] = None) -> CostModel:
             records = records + [r.as_dict() for r in _programs.programs()]
         except Exception:
             pass
+        if per_chip:
+            records = per_chip_records(records)
         return CostModel.fit(records)
     except Exception:
         logger.warning("cost-model fit failed; using analytic prior",

@@ -12,8 +12,11 @@ Named **injection sites** sit on the host-side dispatch paths:
 - ``engine.dispatch`` — inside every batch-engine retry window
   (``map_blocks`` partitions, ``map_rows`` chunks, ``reduce_blocks``)
 - ``serve.prefill`` / ``serve.prefill_chunk`` / ``serve.decode_step``
-  — the generation engine's compiled-step dispatches (inside their
-  retry windows)
+  / ``serve.verify`` — the generation engine's compiled-step
+  dispatches (inside their retry windows); ``serve.verify`` is the
+  speculative-decoding batched multi-token check — a ``transient``
+  there retries the whole verify span invisibly, streams stay
+  byte-identical
 - ``kv_pages.alloc`` — the KV page-pool allocator
 - ``serving.conn`` — the scoring server's per-connection handler
 - ``jobs.block`` — inside a durable batch job's per-block execution
@@ -129,6 +132,7 @@ SITES = (
     "serve.prefill",
     "serve.prefill_chunk",
     "serve.decode_step",
+    "serve.verify",
     "kv_pages.alloc",
     "serving.conn",
     "jobs.block",

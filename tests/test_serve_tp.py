@@ -122,6 +122,65 @@ class TestByteIdentityMatrix:
             np.testing.assert_array_equal(a, b)
 
 
+class TestShardedPrefillAttention:
+    def test_one_pass_prefill_byte_identical_at_every_degree(self, lm):
+        """ISSUE 15 satellite (ROADMAP 1 follow-on): the TP prefill's
+        ATTENTION is now sharded along KV heads — each shard computes
+        only its heads' causal scores/softmax/weighted-sum and the
+        tiled gather reassembles the solo context bit-for-bit. No
+        chunking here, so every prompt takes the one-pass prefill
+        program; greedy AND seeded first tokens (and the decode that
+        follows from the scattered k/v) must match solo at TP=1/2/4."""
+        prompts = _prompts(31, (3, 17, 40))
+        solo = GenerationEngine(lm, max_slots=4, page_size=8,
+                                max_seq_len=64)
+        base_g = solo.generate(prompts, 8)
+        base_s = solo.generate(prompts, 8, temperature=0.9, seed=17,
+                               top_p=0.85)
+        for tp in (1, 2, 4):
+            eng = GenerationEngine(
+                lm, max_slots=4, page_size=8, max_seq_len=64,
+                mesh=make_mesh({"tp": tp}),
+            )
+            for a, b in zip(base_g, eng.generate(prompts, 8)):
+                np.testing.assert_array_equal(a, b)
+            for a, b in zip(
+                base_s,
+                eng.generate(prompts, 8, temperature=0.9, seed=17,
+                             top_p=0.85),
+            ):
+                np.testing.assert_array_equal(a, b)
+            assert eng.num_step_programs <= 2
+
+
+class TestSpeculativeUnderTP:
+    def test_spec_streams_match_solo_at_tp_degrees(self, lm):
+        """ISSUE 15: the verify program shards on KV heads like decode
+        (the draft runs replicated); speculative streams at TP=2/4 are
+        byte-identical to solo non-speculative decode, within the <= 5
+        program budget."""
+        prompts = _prompts(41, (7, 19))
+        solo = GenerationEngine(lm, max_slots=2, page_size=8,
+                                max_seq_len=64)
+        base_g = solo.generate(prompts, 10)
+        base_s = solo.generate(prompts, 10, temperature=0.7, seed=23)
+        for tp in (2, 4):
+            eng = GenerationEngine(
+                lm, max_slots=2, page_size=8, max_seq_len=64,
+                mesh=make_mesh({"tp": tp}),
+                draft_params=lm.params, draft_len=3,
+            )
+            for a, b in zip(base_g, eng.generate(prompts, 10)):
+                np.testing.assert_array_equal(a, b)
+            for a, b in zip(
+                base_s,
+                eng.generate(prompts, 10, temperature=0.7, seed=23),
+            ):
+                np.testing.assert_array_equal(a, b)
+            assert eng.num_step_programs <= 5
+            assert eng.health()["speculative"]["proposed"] > 0
+
+
 # ---------------------------------------------------------------------------
 # mesh validation + pool semantics
 # ---------------------------------------------------------------------------
