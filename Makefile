@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-plan test-spec test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-obsfleet test-plan test-spec test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -69,6 +69,14 @@ test-fleet:
 # deterministic, tier-1
 test-obs:
 	$(PY) -m pytest tests/ -q -m obs
+
+# the fleet-telemetry suite (obs/export.py + obs/aggregate.py +
+# obs/drift.py + obs/requests.py: cross-process snapshot federation
+# incl. the 2-subprocess kill -9 staleness drill, merged-quantile
+# oracles, drift shift/recovery, per-request cost attribution) —
+# CPU-only, deterministic, tier-1
+test-obsfleet:
+	$(PY) -m pytest tests/ -q -m obsfleet
 
 # the logical-plan suite (engine/plan.py: lazy op recording, map
 # fusion, column pruning, reduction hoisting — incl. the per-pass

@@ -736,10 +736,13 @@ def main_decode_serve():
 def _serve_obs_overhead(lm, plen, max_new, iters=3):
     """tokens/s with the tracing layer live vs killed — plus the
     time-series SAMPLER (ISSUE 12) running at a 0.25 s cadence vs
-    parked: best-of ``iters`` interleaved runs of the concurrency-4
-    workload. Both deltas share the ≤ 1% budget; the sampler leg is the
-    worst case for it (a registry walk every 250 ms against a tiny CPU
-    model — real-chip step times dwarf it)."""
+    parked, plus telemetry EXPORT (ISSUE 16: the periodic snapshot
+    federation write, sampler live on both legs so the delta is the
+    export path alone): best-of ``iters`` interleaved runs of the
+    concurrency-4 workload. Each incremental delta carries a ≤ 1%
+    budget; the export leg is a registry walk AND an atomic JSON write
+    every 250 ms against a tiny CPU model — real-chip step times
+    dwarf it."""
     import os
     import shutil
     import tempfile
@@ -749,11 +752,15 @@ def _serve_obs_overhead(lm, plen, max_new, iters=3):
 
     root = tempfile.mkdtemp(prefix="tft-bench-obs-")
     sink = os.path.join(root, "trace.jsonl")
+    tdir = os.path.join(root, "telemetry")
     # the axis FORCES each leg's state; the operator's own setting
     # (e.g. an outer TFT_OBS=0 smoke run) is restored afterwards
     prev_obs = get_config().observability
     prev_interval = get_config().obs_sample_interval_s
+    prev_tdir = get_config().telemetry_dir
+    prev_export = get_config().obs_export_interval_s
     on = off = sampler_on = sampler_off = 0.0
+    export_on = export_off = 0.0
     try:
         for i in range(iters):
             set_config(observability=True)
@@ -795,9 +802,40 @@ def _serve_obs_overhead(lm, plen, max_new, iters=3):
                     lm, 4, plen=plen, max_new=max_new, seed=9500 + i
                 )["tokens_per_sec"],
             )
+            # export pair (ISSUE 16): obs + sampler ON both legs, the
+            # periodic snapshot federation write (every 250 ms) the only
+            # difference — isolating what the telemetry plane itself
+            # costs (the tracing/sampler rows above already price the
+            # rest of the observatory, and that delta is NOT export's)
+            set_config(
+                observability=True, obs_sample_interval_s=0.25,
+                telemetry_dir=tdir, obs_export_interval_s=0.25,
+            )
+            obs.timeseries.acquire_sampler()
+            try:
+                export_on = max(
+                    export_on,
+                    _serve_one_concurrency(
+                        lm, 4, plen=plen, max_new=max_new, seed=9700 + i
+                    )["tokens_per_sec"],
+                )
+            finally:
+                obs.timeseries.release_sampler()
+            set_config(telemetry_dir="")
+            obs.timeseries.acquire_sampler()
+            try:
+                export_off = max(
+                    export_off,
+                    _serve_one_concurrency(
+                        lm, 4, plen=plen, max_new=max_new, seed=9900 + i
+                    )["tokens_per_sec"],
+                )
+            finally:
+                obs.timeseries.release_sampler()
     finally:
         set_config(
-            observability=prev_obs, obs_sample_interval_s=prev_interval
+            observability=prev_obs, obs_sample_interval_s=prev_interval,
+            telemetry_dir=prev_tdir, obs_export_interval_s=prev_export,
         )
         shutil.rmtree(root, ignore_errors=True)
     return {
@@ -809,6 +847,13 @@ def _serve_obs_overhead(lm, plen, max_new, iters=3):
         "sampler_overhead_pct": (
             round((sampler_off - sampler_on) / sampler_off * 100.0, 2)
             if sampler_off
+            else None
+        ),
+        "export_on_tokens_per_sec": round(export_on, 2),
+        "export_off_tokens_per_sec": round(export_off, 2),
+        "export_overhead_pct": (
+            round((export_off - export_on) / export_off * 100.0, 2)
+            if export_off
             else None
         ),
     }
@@ -1210,8 +1255,12 @@ def main_map_rows_journal():
     # (e.g. an outer TFT_OBS=0 smoke run) is restored afterwards
     prev_obs = get_config().observability
     prev_interval = get_config().obs_sample_interval_s
+    prev_tdir = get_config().telemetry_dir
+    prev_export = get_config().obs_export_interval_s
+    bench_tdir = _os.path.join(job_root, "telemetry")
     dt_obs_on = dt_obs_off = float("inf")
     dt_smp_on = dt_smp_off = float("inf")
+    dt_exp_on = dt_exp_off = float("inf")
     try:
         for i in range(iters):
             set_config(observability=True)
@@ -1232,12 +1281,32 @@ def main_map_rows_journal():
             finally:
                 _obs.timeseries.release_sampler()
             dt_smp_off = min(dt_smp_off, one(False, 400 + i))
+            # export pair (ISSUE 16): obs + sampler ON both legs, the
+            # periodic snapshot federation write the only difference —
+            # the telemetry plane's own incremental cost (<= 1% bar)
+            set_config(
+                observability=True, obs_sample_interval_s=0.25,
+                telemetry_dir=bench_tdir, obs_export_interval_s=0.25,
+            )
+            _obs.timeseries.acquire_sampler()
+            try:
+                dt_exp_on = min(dt_exp_on, one(False, 700 + i))
+            finally:
+                _obs.timeseries.release_sampler()
+            set_config(telemetry_dir="")
+            _obs.timeseries.acquire_sampler()
+            try:
+                dt_exp_off = min(dt_exp_off, one(False, 800 + i))
+            finally:
+                _obs.timeseries.release_sampler()
     finally:
         set_config(
-            observability=prev_obs, obs_sample_interval_s=prev_interval
+            observability=prev_obs, obs_sample_interval_s=prev_interval,
+            telemetry_dir=prev_tdir, obs_export_interval_s=prev_export,
         )
     obs_overhead_pct = (dt_obs_on - dt_obs_off) / dt_obs_off * 100.0
     sampler_overhead_pct = (dt_smp_on - dt_smp_off) / dt_smp_off * 100.0
+    export_overhead_pct = (dt_exp_on - dt_exp_off) / dt_exp_off * 100.0
     # autotune axis (ISSUE 13): the same workload with the self-tuning
     # layer OFF vs ONLINE against a throwaway store — the first on-pass
     # pays the micro-benchmark trials (reported as its own wall), the
@@ -1309,6 +1378,15 @@ def main_map_rows_journal():
                         ),
                         "sampler_overhead_pct": round(
                             sampler_overhead_pct, 2
+                        ),
+                        "export_on_rows_per_sec": round(
+                            n_rows / dt_exp_on, 1
+                        ),
+                        "export_off_rows_per_sec": round(
+                            n_rows / dt_exp_off, 1
+                        ),
+                        "export_overhead_pct": round(
+                            export_overhead_pct, 2
                         ),
                     },
                     "autotune": autotune_axis,

@@ -66,6 +66,11 @@ GATE_ENV = {
     # must not pollute the regression baseline — the gate measures the
     # STATIC configuration, `make bench-autotune` measures tuning
     "TFT_TUNE": "0",
+    # fleet-telemetry export (ISSUE 16) pinned OFF: periodic snapshot
+    # writes from an operator's ambient TFT_TELEMETRY_DIR must not
+    # taint the gated numbers — `make bench-serve` measures the export
+    # axis explicitly
+    "TFT_TELEMETRY_DIR": "",
     "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
 }
 

@@ -543,6 +543,7 @@ class LeaseManager:
             _flight.dump_bundle(
                 "fence_reject",
                 debounce_key=f"{block}",
+                series_prefix="jobs.",
                 extra={
                     "block": block,
                     "epoch": epoch,
@@ -872,6 +873,15 @@ def run_worker(
     hb = float(heartbeat_s if heartbeat_s is not None
                else cfg.job_heartbeat_s)
     worker_id = worker_id or _default_worker_id()
+    try:
+        # fleet telemetry: stamp this process's identity gauge and let
+        # the per-pass autoexport below publish it (obs/export.py); a
+        # worker with no telemetry dir configured exports nothing
+        from ..obs import export as _obs_export
+
+        _obs_export.set_identity("job-worker")
+    except Exception:
+        logger.warning("worker telemetry identity failed", exc_info=True)
     lm = LeaseManager(path, worker_id, ttl, hb)
     jl = lm._scan(_JOURNAL_KEY)
     if jl is not None and not jl.expired and jl.worker != worker_id:
@@ -942,6 +952,15 @@ def run_worker(
                 report.passes += 1
                 report.blocks_computed += led.computed
                 report.blocks_quarantined += led.newly_quarantined
+                try:
+                    # piggyback telemetry export on the pass cadence so
+                    # workers without a sampler thread still federate
+                    # (throttled by Config.obs_export_interval_s)
+                    from ..obs import export as _obs_export
+
+                    _obs_export.autoexport()
+                except Exception:
+                    pass
             if led._progressed or led.computed:
                 idle_since = None
                 transient_budget = transient_pass_retries
@@ -961,6 +980,15 @@ def run_worker(
         lm.stop()
         if registered is not None:
             _register_end(led if led is not None else registered, ok)
+        try:
+            # final unthrottled snapshot: the worker's terminal counters
+            # must reach the telemetry dir even if the last autoexport
+            # was inside the throttle window
+            from ..obs import export as _obs_export
+
+            _obs_export.export_snapshot()
+        except Exception:
+            pass
     logger.info(
         "worker %s: job %s terminal after %d pass(es); computed %d "
         "block(s), reclaimed %d lease(s)",

@@ -814,6 +814,7 @@ class BlockLedger:
             # per-block debounce identity: sibling blocks poisoned
             # milliseconds apart each get their linked bundle
             debounce_key=f"{self.job_id}/{i}",
+            series_prefix="jobs.",
             extra={
                 "job_id": self.job_id,
                 "op": self.op,

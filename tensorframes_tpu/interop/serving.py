@@ -246,6 +246,20 @@ class ScoringServer:
 
         _ts.acquire_sampler()
         self._sampler_held = True
+        try:
+            # fleet telemetry identity: a server wrapping an engine is a
+            # serve replica; a score-only server is just a driver process
+            from ..obs import export as _obs_export
+
+            _obs_export.set_identity(
+                "serve-replica" if self._engine is not None else "driver"
+            )
+        except Exception:
+            from ..utils import get_logger
+
+            get_logger("interop.serving").warning(
+                "telemetry identity failed", exc_info=True
+            )
         self._port = s.getsockname()[1]
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
@@ -517,6 +531,28 @@ class ScoringServer:
             degraded = mon.degraded()
         except Exception:
             report["slo"] = []
+        # fleet telemetry summary: when a telemetry dir is configured,
+        # the probe shows every federated process's identity and
+        # staleness (a kill -9'd worker shows up HERE as stale, not by
+        # silently vanishing from the page)
+        try:
+            from ..obs import aggregate as _obs_agg
+            from ..obs import export as _obs_export
+
+            tdir = _obs_export.telemetry_dir()
+            if tdir:
+                fs = _obs_agg.fleet_status(tdir)
+                report["fleet"] = {
+                    "dir": tdir,
+                    "procs": fs.get("procs", []),
+                    "stale": sum(
+                        1 for p in fs.get("procs", []) if p.get("stale")
+                    ),
+                }
+            else:
+                report["fleet"] = None
+        except Exception:
+            report["fleet"] = None
         report["status"] = (
             "unhealthy"
             if not report["healthy"]
@@ -554,6 +590,14 @@ class ScoringServer:
         - ``tune``: the self-tuning layer's view
           (``tensorframes_tpu.tune``: active mode, store path, and
           every installed/stored tuned winner with its source);
+        - ``identity``: this process's fleet identity (proc id, pid,
+          role, package version, device kind — ``obs/export.py``);
+        - ``request_costs``: the top requests by estimated FLOPs from
+          the per-request cost ledger (``obs/requests.py``), tenant
+          label included;
+        - ``fleet``: when a telemetry dir is configured, the federated
+          process table (``obs/aggregate.py`` — merged numbers are on
+          ``GET /varz?scope=fleet``);
         - ``serving``: the engine/fleet health snapshot — per replica:
           ``tp_degree`` and (under tensor parallelism) the ``tp`` block
           with sharded-pool capacity, per-shard pages in use, and
@@ -589,6 +633,26 @@ class ScoringServer:
             }
         except Exception:
             tune_view = None
+        try:
+            from ..obs import export as _obs_export
+            from ..obs import requests as _obs_requests
+
+            identity_view = _obs_export.identity()
+            costs_view = _obs_requests.top_by_cost(10)
+        except Exception:
+            identity_view = None
+            costs_view = []
+        fleet_view = None
+        try:
+            from ..obs import aggregate as _obs_agg
+            from ..obs import export as _obs_export
+
+            tdir = _obs_export.telemetry_dir()
+            if tdir:
+                fs = _obs_agg.fleet_status(tdir)
+                fleet_view = {"dir": tdir, "procs": fs.get("procs", [])}
+        except Exception:
+            fleet_view = None
         payload = {
             "requests": requests[-50:],
             "slowest_requests": slowest,
@@ -611,6 +675,11 @@ class ScoringServer:
             # (tensorframes_tpu.tune): which tuned configs this process
             # is actually running with, and where they came from
             "tune": tune_view,
+            # fleet telemetry: who this process is, what its requests
+            # cost, and (telemetry dir configured) who else is exporting
+            "identity": identity_view,
+            "request_costs": costs_view,
+            "fleet": fleet_view,
         }
         return "200 OK", json.dumps(payload, default=str).encode(
             "utf-8"
@@ -636,8 +705,13 @@ class ScoringServer:
         depths, plus the sampler state. Query params: ``prefix=`` keeps
         only series whose name starts with it; ``window=SECONDS``
         returns the tier-merged trailing window instead of the raw
-        tier. Always 200 (an empty store renders as ``{}``: the sampler
-        simply has not run)."""
+        tier; ``scope=fleet`` answers with the FEDERATED view instead —
+        every process's exported snapshot under the telemetry dir,
+        merged read-side (``obs/aggregate.py``: counters summed,
+        gauges per-proc + sum/max, histogram quantiles recomputed from
+        merged bucket counts, stale exporters flagged). Always 200 (an
+        empty store renders as ``{}``: the sampler simply has not
+        run)."""
         import json
         from urllib.parse import parse_qs
 
@@ -646,22 +720,51 @@ class ScoringServer:
 
         prefix: Optional[str] = None
         window_s: Optional[float] = None
+        scope: Optional[str] = None
         try:
             q = parse_qs(query or "")
             if q.get("prefix"):
                 prefix = q["prefix"][0]
             if q.get("window"):
                 window_s = float(q["window"][0])
+            if q.get("scope"):
+                scope = q["scope"][0]
         except (ValueError, TypeError):
             return (
                 "400 Bad Request",
                 b'{"error": "bad query: expected prefix=NAME and/or '
-                b'window=SECONDS"}',
+                b'window=SECONDS and/or scope=fleet"}',
                 {},
             )
+        if scope == "fleet":
+            from ..obs import aggregate as _obs_agg
+            from ..obs import export as _obs_export
+
+            tdir = _obs_export.telemetry_dir()
+            if not tdir:
+                payload = {
+                    "scope": "fleet",
+                    "enabled": False,
+                    "error": "no telemetry dir configured (set "
+                             "Config.telemetry_dir or TFT_TELEMETRY_DIR)",
+                }
+            else:
+                payload = {"scope": "fleet", "enabled": True}
+                payload.update(_obs_agg.fleet_status(tdir))
+            return (
+                "200 OK",
+                json.dumps(payload, default=str).encode("utf-8"),
+                {},
+            )
+        last_tick = _ts.last_tick_ts()
         payload = {
             "sampler_running": _ts.sampler_running(),
             "interval_s": get_config().obs_sample_interval_s,
+            "last_tick_ts": last_tick,
+            "sampler_lag_s": (
+                None if last_tick is None
+                else max(0.0, time.time() - last_tick)
+            ),
             "series": _ts.store().to_dict(
                 prefix=prefix, window_s=window_s
             ),
@@ -691,6 +794,16 @@ class ScoringServer:
         for k in ("spec_proposed", "spec_accepted", "spec_rolled_back"):
             if k in t:
                 out[k] = int(t[k])
+        # per-request cost attribution (obs/requests.py): what this
+        # request consumed, echoed so the caller can bill without
+        # scraping the server-side ledger
+        for k in ("tokens", "kv_pages", "prefix_cached_tokens"):
+            if k in t:
+                out[k] = int(t[k])
+        if "est_flops" in t:
+            out["est_flops"] = float(t["est_flops"])
+        if t.get("tenant"):
+            out["tenant"] = str(t["tenant"])
         return out
 
     def _handle_generate(
@@ -774,6 +887,14 @@ class ScoringServer:
                                   "engine (serve.Fleet)"},
                     )
                 kwargs["session"] = str(spec["session"])
+            tenant = spec.get("tenant")
+            if tenant is None:
+                tenant = spec.get("session")
+            if tenant is not None:
+                # cost-attribution label; only passed when the client
+                # supplied one so duck-typed engines without the kwarg
+                # keep working
+                kwargs["tenant"] = str(tenant)
         except (ValueError, KeyError, TypeError) as e:
             return reply(
                 "400 Bad Request",

@@ -44,6 +44,10 @@ from . import flight
 from . import programs
 from . import slo
 from . import timeseries
+from . import export
+from . import aggregate
+from . import drift
+from . import requests
 
 __all__ = [
     "Counter",
@@ -73,4 +77,8 @@ __all__ = [
     "programs",
     "slo",
     "timeseries",
+    "export",
+    "aggregate",
+    "drift",
+    "requests",
 ]

@@ -1,0 +1,297 @@
+"""Telemetry aggregation: merge per-process snapshots into a fleet view.
+
+The read side of the fleet telemetry plane (:mod:`.export` is the write
+side). :func:`read_snapshots` loads every valid ``*.json`` under the
+telemetry directory; :func:`merge` combines any set of snapshots into
+one fleet-wide view; :func:`fleet_status` is the memoized top-level API
+mirroring ``engine.dist_jobs.journal_status`` (read-only, any process,
+cheap to poll — ``GET /varz?scope=fleet`` and the ``/statusz`` fleet
+block call it per request).
+
+Merge rules — the part that must be EXACT, not approximate:
+
+- **counters** sum per labeled series across processes (monotonic
+  totals add);
+- **gauges** keep per-process values plus ``sum`` and ``max`` — neither
+  reduction alone is right for every gauge (queue depths sum, a
+  utilization gauge wants max), so the fleet view keeps both and the
+  per-proc breakdown;
+- **histograms** merge by BUCKET COUNTS: every process uses the same
+  fixed bounds (``metrics.DEFAULT_BUCKETS`` — fixed "so series from
+  different processes always merge bucket-for-bucket"), so elementwise
+  count addition gives exactly the histogram a single process observing
+  the union would hold, and :func:`~.metrics.quantile_from_counts` over
+  the merged counts is bucket-exact — identical to the oracle over the
+  combined observations. Mismatched bounds (a cross-version process)
+  keep the first process's data and flag ``"mixed_buckets"`` rather
+  than silently adding apples to oranges;
+- **time series** align by tick: points from different processes are
+  bucketed to the integer second; ``.rate`` series (per-second rates
+  derived from counters) SUM within a tick, everything else (gauges,
+  quantiles) takes the mean, and each merged series lists the
+  contributing procs;
+- **staleness** is flagged, never dropped: a process whose snapshot
+  file stopped refreshing (mtime older than
+  ``Config.telemetry_stale_after_s``) stays in the view with
+  ``stale: true`` — a kill -9'd worker's last counters remain visible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+from .export import SCHEMA_VERSION
+from .metrics import quantile_from_counts
+
+__all__ = [
+    "fleet_status",
+    "merge",
+    "read_snapshots",
+]
+
+logger = get_logger("obs.aggregate")
+
+
+def read_snapshots(dir: str) -> List[Dict[str, Any]]:
+    """Every valid snapshot under ``dir``, sorted by proc id. Tolerant
+    by design: torn/corrupt files (a reader racing a non-atomic writer
+    — cannot happen with :mod:`.export` but the directory is shared),
+    foreign schemas, and non-snapshot JSON are skipped with a debug log,
+    never raised — one bad file must not blind the whole pane."""
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(dir))
+    except OSError:
+        return out
+    for fname in names:
+        if not fname.endswith(".json"):
+            continue
+        path = os.path.join(dir, fname)
+        try:
+            mtime = os.stat(path).st_mtime
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            logger.debug("skipping unreadable snapshot %s", path)
+            continue
+        if (
+            not isinstance(snap, dict)
+            or snap.get("schema") != SCHEMA_VERSION
+            or "proc" not in snap
+        ):
+            logger.debug("skipping foreign snapshot %s", path)
+            continue
+        snap["_mtime"] = mtime
+        out.append(snap)
+    out.sort(key=lambda s: str(s.get("proc")))
+    return out
+
+
+def _merge_counter(dst: Dict[str, float], values: Dict[str, Any]) -> None:
+    for ls, v in values.items():
+        try:
+            dst[ls] = dst.get(ls, 0.0) + float(v)
+        except (TypeError, ValueError):
+            continue
+
+
+def _merge_gauge(
+    dst: Dict[str, Dict[str, float]], proc: str, values: Dict[str, Any]
+) -> None:
+    for ls, v in values.items():
+        try:
+            dst.setdefault(ls, {})[proc] = float(v)
+        except (TypeError, ValueError):
+            continue
+
+
+def _merge_histogram(
+    entry: Dict[str, Any], buckets: List[float], values: Dict[str, Any]
+) -> None:
+    if entry.get("buckets") is None:
+        entry["buckets"] = list(buckets)
+    elif list(buckets) != entry["buckets"]:
+        entry["mixed_buckets"] = True
+        return
+    dst = entry["values"]
+    for ls, s in values.items():
+        try:
+            counts = [int(c) for c in s["counts"]]
+            ssum, scount = float(s["sum"]), int(s["count"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        cur = dst.get(ls)
+        if cur is None:
+            dst[ls] = {"counts": counts, "sum": ssum, "count": scount}
+        elif len(cur["counts"]) == len(counts):
+            cur["counts"] = [a + b for a, b in zip(cur["counts"], counts)]
+            cur["sum"] += ssum
+            cur["count"] += scount
+
+
+def merge(
+    snapshots: List[Dict[str, Any]],
+    now: Optional[float] = None,
+    stale_after_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Combine snapshots into one fleet view (rules in the module doc).
+
+    Returns ``{"procs": [...], "metrics": {...}, "series": {...}}``.
+    ``procs`` rows carry identity + ``age_s`` + ``stale`` (mtime-based,
+    threshold ``stale_after_s`` / ``Config.telemetry_stale_after_s``);
+    merged histogram values gain exact ``p50``/``p99``."""
+    ts_now = time.time() if now is None else now
+    if stale_after_s is None:
+        from ..utils.config import get_config
+
+        stale_after_s = get_config().telemetry_stale_after_s
+
+    procs: List[Dict[str, Any]] = []
+    metrics: Dict[str, Dict[str, Any]] = {}
+    series_acc: Dict[str, Dict[int, List[float]]] = {}
+    series_procs: Dict[str, set] = {}
+
+    for snap in snapshots:
+        proc = str(snap.get("proc"))
+        ident = snap.get("identity") or {}
+        age = ts_now - snap.get("_mtime", snap.get("ts_unix", ts_now))
+        procs.append({
+            "proc": proc,
+            "pid": snap.get("pid"),
+            "role": ident.get("role", "unknown"),
+            "version": ident.get("version", "unknown"),
+            "device": ident.get("device", "unknown"),
+            "host": ident.get("host"),
+            "ts_unix": snap.get("ts_unix"),
+            "age_s": round(age, 3),
+            "stale": age > stale_after_s,
+        })
+        for name, m in (snap.get("metrics") or {}).items():
+            if not isinstance(m, dict) or "type" not in m:
+                continue
+            entry = metrics.setdefault(name, {
+                "type": m["type"],
+                "help": m.get("help", ""),
+                "labels": m.get("labels", []),
+                "values": {},
+                "per_proc": {} if m["type"] == "gauge" else None,
+            })
+            if entry["type"] != m["type"]:
+                entry["mixed_types"] = True
+                continue
+            values = m.get("values") or {}
+            if m["type"] == "counter":
+                _merge_counter(entry["values"], values)
+            elif m["type"] == "gauge":
+                _merge_gauge(entry["per_proc"], proc, values)
+            elif m["type"] == "histogram":
+                _merge_histogram(
+                    entry, m.get("buckets") or [], values
+                )
+        for name, pts in (snap.get("series") or {}).items():
+            acc = series_acc.setdefault(name, {})
+            series_procs.setdefault(name, set()).add(proc)
+            for p in pts:
+                try:
+                    pts_ts, v = float(p[0]), float(p[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                acc.setdefault(int(pts_ts), []).append(v)
+
+    # finalize gauges (sum/max alongside the per-proc breakdown) and
+    # histogram quantiles (bucket-exact over the merged counts)
+    for name, entry in metrics.items():
+        if entry["type"] == "gauge":
+            for ls, by_proc in entry["per_proc"].items():
+                vals = list(by_proc.values())
+                entry["values"][ls] = {
+                    "sum": sum(vals),
+                    "max": max(vals),
+                    "procs": dict(by_proc),
+                }
+            entry.pop("per_proc")
+        else:
+            entry.pop("per_proc", None)
+            if entry["type"] == "histogram":
+                bounds = entry.get("buckets") or []
+                for ls, s in entry["values"].items():
+                    for suffix, q in (("p50", 0.5), ("p99", 0.99)):
+                        s[suffix] = quantile_from_counts(
+                            bounds, s["counts"], s["count"], q
+                        )
+
+    series: Dict[str, Any] = {}
+    for name, acc in series_acc.items():
+        rate_like = name.endswith(".rate")
+        pts = []
+        for tick in sorted(acc):
+            vals = acc[tick]
+            v = sum(vals) if rate_like else sum(vals) / len(vals)
+            pts.append([float(tick), v])
+        series[name] = {
+            "points": pts,
+            "procs": sorted(series_procs[name]),
+            "merge": "sum" if rate_like else "mean",
+        }
+
+    return {"procs": procs, "metrics": metrics, "series": series}
+
+
+# -- memoized top-level API ---------------------------------------------------
+
+#: dir -> (stamp, parsed snapshots); the PARSE is memoized on the
+#: directory's (fname, mtime_ns, size) stamp — the merge itself is
+#: recomputed per call because staleness is a function of *now*, not of
+#: the files (the journal_status memo in engine/dist_jobs.py splits
+#: static-vs-live state the same way)
+_status_cache: Dict[str, Tuple[Tuple, List[Dict[str, Any]]]] = {}
+_status_cache_lock = threading.Lock()
+_STATUS_CACHE_MAX = 8
+
+
+def _dir_stamp(dir: str) -> Tuple:
+    try:
+        entries = []
+        for fname in os.listdir(dir):
+            if not fname.endswith(".json"):
+                continue
+            try:
+                st = os.stat(os.path.join(dir, fname))
+                entries.append((fname, st.st_mtime_ns, st.st_size))
+            except OSError:
+                continue
+        return tuple(sorted(entries))
+    except OSError:
+        return ()
+
+
+def fleet_status(
+    dir: str,
+    now: Optional[float] = None,
+    stale_after_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """One-call fleet view over a telemetry directory — the
+    ``journal_status`` of the telemetry plane: read-only, callable from
+    any process, cheap to poll (snapshot parsing is memoized on the
+    directory's mtime stamp; only the time-dependent merge reruns)."""
+    stamp = _dir_stamp(dir)
+    with _status_cache_lock:
+        hit = _status_cache.get(dir)
+    if hit is not None and hit[0] == stamp:
+        snaps = hit[1]
+    else:
+        snaps = read_snapshots(dir)
+        with _status_cache_lock:
+            if len(_status_cache) >= _STATUS_CACHE_MAX and dir not in (
+                _status_cache
+            ):
+                _status_cache.pop(next(iter(_status_cache)))
+            _status_cache[dir] = (stamp, snaps)
+    out = merge(snaps, now=now, stale_after_s=stale_after_s)
+    out["dir"] = dir
+    return out

@@ -18,7 +18,9 @@ per event, memory strictly bounded) fed by the failure-adjacent paths:
   (``engine/dist_jobs.py``);
 - ``serve`` / ``fleet`` / ``jobs`` / ``serving`` — subsystem lifecycle
   events (engine fatal/restart, replica fence/replay, block quarantine,
-  request completions).
+  request completions);
+- ``slo`` / ``drift`` — objective breach/recovery and drift
+  shift/recovery transitions (``obs/slo.py``, ``obs/drift.py``).
 
 On a terminal event — engine fatal step, ``restart()``, block
 quarantine, write-fence reject — :func:`dump_bundle` snapshots the whole
@@ -220,6 +222,8 @@ def dump_bundle(
     extra: Optional[Dict[str, Any]] = None,
     dir: Optional[str] = None,
     debounce_key: Optional[str] = None,
+    series_prefix: Optional[str] = None,
+    series_window_s: float = 300.0,
 ) -> Optional[str]:
     """Write one debug bundle and return its path (``None`` when
     observability is off, the same ``reason``+directory dumped within
@@ -231,11 +235,17 @@ def dump_bundle(
     each deserve their bundle — pass the failing unit's id so only true
     repeats are suppressed.
 
+    ``series_prefix`` additionally captures the triggering subsystem's
+    recent time-series trajectory (every stored series under the
+    prefix, trailing ``series_window_s``) — a fatal's bundle then shows
+    the minutes INTO the failure, not just the terminal state.
+
     The bundle is a single JSON file::
 
         {"reason": ..., "ts_unix": ..., "host": ..., "pid": ...,
          "rings": {subsystem: [events...]},   # the flight recorder
          "metrics": {...},                    # obs.snapshot()
+         "timeseries": {...},                 # windowed series (opt-in)
          "health": {...},                     # caller's health() report
          "config": {...},                     # resolved Config
          "chaos_spec": "...",                 # active chaos schedule
@@ -283,6 +293,16 @@ def dump_bundle(
             "chaos_spec": _chaos.active_spec(),
             "extra": extra or {},
         }
+        if series_prefix is not None:
+            from . import timeseries as _ts
+
+            bundle["timeseries"] = {
+                "prefix": series_prefix,
+                "window_s": series_window_s,
+                "series": _ts.store().to_dict(
+                    prefix=series_prefix, window_s=series_window_s
+                ),
+            }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(bundle, f, indent=1, default=str)

@@ -1,0 +1,151 @@
+"""Per-request cost attribution: what each served request actually cost.
+
+Aggregate serving metrics (tokens/s, queue depth) say how the ENGINE is
+doing; multi-tenant QoS (ROADMAP item 2) needs to know what each
+REQUEST did — only attributable costs can be quota'd, shed, or billed.
+The serving engine calls :func:`record_request` from its scheduler's
+finish hook with the request's terminal accounting:
+
+- ``tokens`` generated, ``kv_pages`` held at finish, and
+  ``prefix_cached_tokens`` the prefix cache saved it from prefilling;
+- ``est_flops``: the estimated floating-point cost — each prefill /
+  chunk / decode / draft / verify dispatch's ``ProgramRecord`` FLOP
+  estimate, apportioned equally over the requests sharing the batch
+  that dispatch served;
+- speculative proposal/acceptance counts (the per-request acceptance
+  rate);
+- the ``tenant`` label (defaulting to the fleet session id) — the key
+  QoS policies will act on.
+
+Records land in a bounded in-memory ring (the ``/statusz``
+``request_costs`` top-N reads it) and append to a bounded
+``requests.jsonl`` (rotated once over the size cap, same policy as the
+trace sink) next to the job journals — the durable feed for offline
+cost analysis and the learned cost model's per-request training data.
+Kill-switch parity: under ``TFT_OBS=0`` nothing is recorded or written.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import get_logger
+from .metrics import enabled
+
+__all__ = [
+    "record_request",
+    "recent",
+    "requests_path",
+    "reset",
+    "top_by_cost",
+]
+
+logger = get_logger("obs.requests")
+
+#: in-memory ring depth — enough for a top-N over recent traffic
+#: without ever growing with uptime
+_RING = 512
+
+#: rotate requests.jsonl past this size (the current file moves to
+#: ``.1``, replacing any previous ``.1`` — at most 2x the cap on disk)
+_MAX_BYTES = 8 << 20
+
+_lock = threading.Lock()
+_records: "collections.deque[Dict[str, Any]]" = collections.deque(
+    maxlen=_RING
+)
+_write_failed = False  # warn once; cost accounting must not spam
+
+
+def requests_path() -> str:
+    """Where request records persist: ``TFT_REQUESTS_FILE``, else
+    ``requests.jsonl`` next to the batch-job journal root (the same
+    trajectory directory ``programs.jsonl`` and the bench artifacts
+    use)."""
+    explicit = os.environ.get("TFT_REQUESTS_FILE", "")
+    if explicit:
+        return explicit
+    from ..utils.config import get_config
+
+    root = (
+        get_config().job_dir
+        or os.environ.get("TFT_JOB_DIR")
+        or os.path.join(
+            os.path.expanduser("~"), ".cache", "tensorframes_tpu", "jobs"
+        )
+    )
+    return os.path.join(root, "requests.jsonl")
+
+
+def record_request(**fields: Any) -> Optional[Dict[str, Any]]:
+    """Record one finished request's cost row; returns the row (or
+    ``None`` under the kill switch). Never raises — accounting sits on
+    the engine's finish path."""
+    if not enabled():
+        return None
+    row = {"ts": round(time.time(), 3)}
+    row.update(fields)
+    with _lock:
+        _records.append(row)
+    _append_line(row)
+    return row
+
+
+def _append_line(row: Dict[str, Any]) -> None:
+    global _write_failed
+    try:
+        path = requests_path()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with _lock:
+            try:
+                if os.path.getsize(path) >= _MAX_BYTES:
+                    os.replace(path, path + ".1")
+            except OSError:
+                pass  # absent file: nothing to rotate
+            with open(path, "a") as f:
+                f.write(json.dumps(row, default=str) + "\n")
+    except Exception:
+        if not _write_failed:
+            _write_failed = True
+            logger.warning(
+                "request-cost persist failed (suppressing further "
+                "warnings)", exc_info=True,
+            )
+
+
+def recent(n: int = _RING) -> List[Dict[str, Any]]:
+    """Newest-last copy of the in-memory ring (at most ``n`` rows)."""
+    with _lock:
+        rows = list(_records)
+    return rows[-n:]
+
+
+def top_by_cost(n: int = 10) -> List[Dict[str, Any]]:
+    """The ``n`` most expensive recent requests by ``est_flops``
+    (tokens break ties — a request served entirely from cache hints
+    has no FLOP estimate but still did work) — the ``/statusz``
+    ``request_costs`` table."""
+    with _lock:
+        rows = list(_records)
+    rows.sort(
+        key=lambda r: (
+            float(r.get("est_flops") or 0.0),
+            int(r.get("tokens") or 0),
+        ),
+        reverse=True,
+    )
+    return rows[:n]
+
+
+def reset() -> None:
+    """Drop the in-memory ring (the JSONL is untouched) — test
+    isolation."""
+    global _write_failed
+    with _lock:
+        _records.clear()
+    _write_failed = False

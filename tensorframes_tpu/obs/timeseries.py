@@ -72,6 +72,7 @@ from .metrics import (
 __all__ = [
     "TimeSeriesStore",
     "acquire_sampler",
+    "last_tick_ts",
     "release_sampler",
     "sample_once",
     "sampler_running",
@@ -87,6 +88,12 @@ _m_ticks = _counter(
 _g_series = _gauge(
     "obs.ts_series",
     "Series currently tracked by the in-process time-series store",
+)
+_g_lag = _gauge(
+    "obs.ts_sampler_lag_seconds",
+    "Gap between the last two completed sampler ticks — a stalled, "
+    "leaked, or overloaded sampler is itself detectable here (the "
+    "live now-minus-last-tick lag is derived on /varz)",
 )
 
 #: histogram quantiles snapshotted per tick, as (suffix, q)
@@ -191,6 +198,10 @@ class TimeSeriesStore:
         #: WINDOWED per-tick quantiles: series name -> (counts, count)
         self._last_hist: Dict[str, Tuple[List[int], int]] = {}
         self._dropped = False
+        #: wall-clock timestamp of the last completed tick (None before
+        #: the first) — the sampler's own liveness signal: /varz shows
+        #: it and derives the current lag from it
+        self._last_tick_ts: Optional[float] = None
 
     # -- recording ---------------------------------------------------------
 
@@ -291,6 +302,9 @@ class TimeSeriesStore:
                                     recorded += 1
                     self._rate(base + ".rate", ts, float(s["count"]))
         _g_series.set(float(len(self._series)))
+        if self._last_tick_ts is not None:
+            _g_lag.set(max(0.0, ts - self._last_tick_ts))
+        self._last_tick_ts = ts
         _m_ticks.inc()
         return recorded
 
@@ -372,6 +386,7 @@ class TimeSeriesStore:
             self._last_cum.clear()
             self._last_hist.clear()
             self._dropped = False
+            self._last_tick_ts = None
 
 
 _store = TimeSeriesStore()
@@ -383,10 +398,19 @@ def store() -> TimeSeriesStore:
     return _store
 
 
+def last_tick_ts() -> Optional[float]:
+    """Wall-clock timestamp of the default store's last completed tick
+    (``None`` before the first) — ``/varz`` derives the live sampler
+    lag from it, and telemetry snapshots carry it."""
+    return _store._last_tick_ts
+
+
 def sample_once(now: Optional[float] = None) -> int:
     """One deterministic sampler tick against the default store,
-    including the piggybacked duties (SLO evaluation, program-registry
-    persistence) — what the background thread runs on its cadence."""
+    including the piggybacked duties (SLO evaluation, drift detection,
+    program-registry persistence, telemetry export — export last, so a
+    snapshot sees this tick's drift gauges) — what the background
+    thread runs on its cadence."""
     n = _store.sample(now)
     try:
         from . import slo as _slo
@@ -395,11 +419,23 @@ def sample_once(now: Optional[float] = None) -> int:
     except Exception:
         logger.warning("SLO evaluation failed", exc_info=True)
     try:
+        from . import drift as _drift
+
+        _drift.monitor().evaluate(_store, now=now)
+    except Exception:
+        logger.warning("drift evaluation failed", exc_info=True)
+    try:
         from . import programs as _programs
 
         _programs.autopersist()
     except Exception:
         logger.warning("program-registry persistence failed", exc_info=True)
+    try:
+        from . import export as _export
+
+        _export.autoexport(now=now)
+    except Exception:
+        logger.warning("telemetry export failed", exc_info=True)
     return n
 
 

@@ -81,6 +81,7 @@ from ..obs import (
     current_trace as _current_trace,
     flight as _flight,
     programs as _programs,
+    requests as _obs_requests,
     span as _span,
     use_trace as _use_trace,
 )
@@ -795,6 +796,9 @@ class GenerationEngine:
                 self._collective_step_s,
                 self._collective_bytes_per_step,
             ) = estimate_collective_seconds(self, mesh, self._tp_axis)
+        # per-request cost attribution (obs/requests.py): observe every
+        # finishing slot while it still holds its pages
+        self.scheduler.on_request_done = self._account_request
 
     # -- tuned serving knobs ----------------------------------------------
 
@@ -1251,6 +1255,7 @@ class GenerationEngine:
         timeout: Optional[float] = None,
         deadline: Optional[float] = None,
         trace=None,
+        tenant: str = "",
         _handle_factory=None,
     ) -> GenerationHandle:
         """Queue one generation request; returns its streaming handle.
@@ -1268,6 +1273,10 @@ class GenerationEngine:
         engine-side spans join (default: the submitting thread's
         current trace, so an HTTP ``traceparent`` flows through without
         every caller threading it explicitly).
+
+        ``tenant`` keys the request's cost-attribution record
+        (``obs/requests.py``; empty = unattributed) — the fleet fills
+        it from the session id when the client names no tenant.
 
         ``_handle_factory`` (private) lets the fleet router
         (``serve/fleet.py``) substitute its relay handle —
@@ -1315,6 +1324,7 @@ class GenerationEngine:
                 None if deadline is None else time.monotonic() + deadline
             ),
             trace=trace if trace is not None else _current_trace(),
+            tenant=str(tenant or ""),
         )
         try:
             self.scheduler.submit(req, block=block, timeout=timeout)
@@ -1521,6 +1531,12 @@ class GenerationEngine:
             if act.cached_tokens > 0:
                 _m_prefix_hits.inc()
                 _m_prefix_tokens_saved.inc(act.cached_tokens)
+                # cost attribution: tokens this request never prefilled
+                # (accumulates across preemption re-admissions)
+                timings["prefix_cached_tokens"] = (
+                    timings.get("prefix_cached_tokens", 0)
+                    + act.cached_tokens
+                )
         if self.draft_len and act.cached_tokens > 0:
             # shared prefix pages carry the donor's DRAFT-KV rows too
             # (same page indices in the draft group), so the draft skips
@@ -1622,6 +1638,7 @@ class GenerationEngine:
             timings.get("prefill_s", 0.0) + time.perf_counter() - t0
         )
         timings["prefill_chunks"] = timings.get("prefill_chunks", 0) + 1
+        self._charge_flops(timings, self._prefill_chunk_jit)
         act.prefill_pos = start + valid
         _m_prefill_chunks.inc()
         if act.prefill_pos >= plen:
@@ -1672,6 +1689,7 @@ class GenerationEngine:
         timings["prefill_s"] = (
             timings.get("prefill_s", 0.0) + time.perf_counter() - t0
         )
+        self._charge_flops(timings, self._prefill_jit)
         act.prefill_pos = plen
         self._register_prefix(act)
         self._emit(idx, act, int(tok))
@@ -1714,7 +1732,11 @@ class GenerationEngine:
             )
         self._charge_collectives()
         nxt = np.asarray(nxt)
+        share = 1.0 / max(1, len(ready))
         for idx, act in ready:
+            self._charge_flops(
+                act.req.handle.timings, self._decode_jit, share
+            )
             self._emit(idx, act, int(nxt[idx]))
 
     # -- speculative decoding ---------------------------------------------
@@ -1933,6 +1955,9 @@ class GenerationEngine:
             timings["spec_rolled_back"] = (
                 timings.get("spec_rolled_back", 0) + (k - accept)
             )
+            spec_share = 1.0 / max(1, len(ready))
+            self._charge_flops(timings, self._draft_jit, spec_share)
+            self._charge_flops(timings, self._verify_jit, spec_share)
             # emit the target's tokens: the accepted run plus the
             # correction/bonus token — u[accept] is what solo decode
             # would have emitted at that position either way
@@ -1968,6 +1993,47 @@ class GenerationEngine:
         if (eos is not None and tok == eos) or act.remaining <= 0:
             self.scheduler.finish(idx)
             _m_requests.inc(status="completed")
+
+    @staticmethod
+    def _charge_flops(timings: dict, prog, share: float = 1.0) -> None:
+        """Accumulate one dispatch's estimated FLOPs into a request's
+        cost ledger: ``share`` of the program's ``ProgramRecord`` FLOP
+        estimate (batched dispatches apportion equally over the
+        requests the batch served). Silently zero until the program's
+        first-dispatch cost estimate lands, and under ``TFT_OBS=0``."""
+        rec = getattr(prog, "record", None)
+        flops = getattr(rec, "flops", None) if rec is not None else None
+        if flops:
+            timings["est_flops"] = (
+                timings.get("est_flops", 0.0) + float(flops) * share
+            )
+
+    def _account_request(self, act: _Active, error) -> None:
+        """Scheduler finish hook: the request's terminal cost record
+        (``obs/requests.py``), taken while the slot still holds its
+        pages so holdings are countable. ``timings`` gets the same keys
+        so the HTTP response echoes them."""
+        req = act.req
+        t = req.handle.timings
+        t["tokens"] = req.emitted + len(act.generated)
+        t["kv_pages"] = max(int(t.get("kv_pages", 0)), len(act.seq.pages))
+        if req.tenant:
+            t["tenant"] = req.tenant
+        _obs_requests.record_request(
+            request_id=req.request_id,
+            engine=self.name,
+            tenant=req.tenant,
+            status="failed" if error is not None else "completed",
+            tokens=t["tokens"],
+            kv_pages=t["kv_pages"],
+            prefix_cached_tokens=int(t.get("prefix_cached_tokens", 0)),
+            spec_proposed=int(t.get("spec_proposed", 0)),
+            spec_accepted=int(t.get("spec_accepted", 0)),
+            est_flops=float(t.get("est_flops", 0.0)),
+            queue_wait_s=t.get("queue_wait_s"),
+            prefill_s=t.get("prefill_s"),
+            decode_s=t.get("decode_s"),
+        )
 
     def _refresh_gauges(self) -> None:
         _m_queue_depth.set(float(self.scheduler.queue_depth))
@@ -2034,6 +2100,7 @@ class GenerationEngine:
         _flight.dump_bundle(
             "engine_fatal",
             health=self.health(),
+            series_prefix="serve.",
             extra={
                 "error_type": type(error).__name__,
                 "error": str(error)[:2000],
@@ -2084,6 +2151,7 @@ class GenerationEngine:
         _flight.dump_bundle(
             "engine_restart",
             health=self.health(),
+            series_prefix="serve.",
             extra={"requeued": self.scheduler.queue_depth},
         )
         with self.scheduler._lock:

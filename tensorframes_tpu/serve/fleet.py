@@ -151,6 +151,7 @@ class _FleetRequest:
         "request_id", "prompt", "max_new_tokens", "temperature", "top_p",
         "seed", "eos_id", "deadline_t", "session", "handle", "replica",
         "inner", "replays", "last_error", "lock", "parked_t", "trace",
+        "tenant",
     )
 
     def __init__(
@@ -165,6 +166,7 @@ class _FleetRequest:
         deadline_t: Optional[float],
         session: Optional[str],
         handle: FleetHandle,
+        tenant: str = "",
     ):
         self.request_id = request_id
         self.prompt = prompt
@@ -176,6 +178,7 @@ class _FleetRequest:
         self.deadline_t = deadline_t
         self.session = session
         self.handle = handle
+        self.tenant = tenant
         self.replica: Optional["_Replica"] = None
         self.inner: Optional["_RelayHandle"] = None
         self.replays = 0
@@ -527,6 +530,7 @@ class Fleet:
             block=False,
             deadline=deadline,
             trace=rec.trace,
+            tenant=rec.tenant,
             _handle_factory=lambda rid: _RelayHandle(rid, self, rec),
         )
         rec.replica = rep
@@ -543,6 +547,7 @@ class Fleet:
         timeout: Optional[float] = None,
         deadline: Optional[float] = None,
         session: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> FleetHandle:
         """Place one request on a healthy replica; returns its streaming
         handle. Raises ``ValueError`` for infeasible requests (every
@@ -551,7 +556,10 @@ class Fleet:
         queue is full (``block=True`` waits up to ``timeout`` for room),
         and :class:`EngineUnhealthyError` when ALL replicas are fenced
         (the endpoint's 503). ``session`` pins subsequent requests with
-        the same key to one replica while it stays healthy."""
+        the same key to one replica while it stays healthy. ``tenant``
+        labels the request's cost-attribution record
+        (``obs/requests.py``); it defaults to the session key so
+        session-affine traffic is attributable without extra plumbing."""
         if self._closed and self._thread is None:
             raise EngineUnhealthyError("fleet is stopped")
         if deadline is not None and deadline <= 0:
@@ -582,6 +590,7 @@ class Fleet:
             None if deadline is None else time.monotonic() + float(deadline),
             session,
             FleetHandle(rid),
+            tenant=str(tenant if tenant is not None else (session or "")),
         )
         # one trace_id for the request's whole life, however many
         # replicas serve it (the HTTP handler installs the traceparent's

@@ -76,7 +76,10 @@ class GenerationHandle:
         #: ``prefill_s`` (sum of prefill dispatch walls — replays and
         #: recompute-style preemptions accumulate), ``prefill_chunks``
         #: (chunked-prefill dispatches), ``decode_s`` (sum of
-        #: inter-emission gaps), ``replays`` (fleet failovers). The
+        #: inter-emission gaps), ``replays`` (fleet failovers) — plus
+        #: the cost-attribution keys the engine's finish hook records
+        #: (``tokens``, ``kv_pages``, ``prefix_cached_tokens``,
+        #: ``est_flops``, ``tenant``; ``obs/requests.py``). The
         #: serving endpoint echoes this dict in the HTTP response
         #: (docs/observability.md).
         self.timings: dict = {}
@@ -148,6 +151,10 @@ class GenRequest:
     #: from the HTTP ingress through placement, prefill, and any
     #: failover replay (docs/observability.md)
     trace: Optional[object] = None
+    #: cost-attribution key (``obs/requests.py``): who this request is
+    #: billed to. The serving layer defaults it to the fleet session id
+    #: when the client names no tenant; empty means unattributed.
+    tenant: str = ""
 
 
 class _Active:
@@ -232,6 +239,11 @@ class Scheduler:
         self._waiting: Deque[GenRequest] = deque()
         self._lock = threading.Condition()
         self._admit_counter = 0
+        #: optional ``fn(act, error)`` called by :meth:`finish` while
+        #: the slot still holds its pages — the engine hangs its
+        #: per-request cost attribution here (page count, token totals)
+        #: without the scheduler importing any observability
+        self.on_request_done = None
 
     # -- admission ---------------------------------------------------------
 
@@ -429,6 +441,7 @@ class Scheduler:
             emitted=req.emitted + len(act.generated),
             deadline_t=req.deadline_t,
             trace=req.trace,
+            tenant=req.tenant,
         )
         record_preemption("serve")
         self._requeue_front(new_req)
@@ -444,9 +457,17 @@ class Scheduler:
             act.cow_src = None
 
     def finish(self, idx: int, error: Optional[BaseException] = None) -> None:
-        """Terminal slot release: pages back to the pool, handle closed."""
+        """Terminal slot release: pages back to the pool, handle closed.
+        ``on_request_done`` observes the slot first (pages still held,
+        so holdings are countable); its failures are swallowed — an
+        accounting bug must not leak pages or hang a handle."""
         act = self.slots[idx]
         assert act is not None
+        if self.on_request_done is not None:
+            try:
+                self.on_request_done(act, error)
+            except Exception:
+                pass
         self._drop_cow(act)
         act.seq.release()
         self.slots[idx] = None

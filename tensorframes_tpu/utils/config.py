@@ -205,6 +205,23 @@ class Config:
     #: persistent compile cache directory (the same
     #: ``~/.cache/tensorframes_tpu`` trajectory home).
     tune_file: str = ""
+    #: shared directory for the fleet telemetry plane
+    #: (``obs/export.py``): every process with a live sampler snapshots
+    #: its metric registry + time-series store to
+    #: ``<dir>/<proc-id>.json`` (atomic rename), and the read side
+    #: (``obs/aggregate.py``, ``GET /varz?scope=fleet``) merges whatever
+    #: snapshots it finds there. Empty means ``$TFT_TELEMETRY_DIR``;
+    #: empty both ways disables export entirely.
+    telemetry_dir: str = ""
+    #: minimum seconds between telemetry snapshot writes. The exporter
+    #: rides the time-series sampler tick, so the effective cadence is
+    #: ``max(obs_sample_interval_s, this)``. Re-read every tick.
+    obs_export_interval_s: float = 2.0
+    #: a telemetry snapshot whose file mtime is older than this many
+    #: seconds marks its process ``stale`` in every merged fleet view —
+    #: flagged, never dropped, so a kill -9'd worker's last counters
+    #: stay visible (docs/observability.md "Fleet telemetry").
+    telemetry_stale_after_s: float = 15.0
 
 
 _lock = threading.Lock()

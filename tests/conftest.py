@@ -54,6 +54,17 @@ def _program_costs_in_tmp(tmp_path_factory):
 
 
 @pytest.fixture(autouse=True, scope="session")
+def _request_ledger_in_tmp(tmp_path_factory):
+    """The per-request cost ledger (obs/requests.py, fed by engine
+    request completion) appends to the test session's tmp dir, not the
+    developer's journal root."""
+    os.environ.setdefault(
+        "TFT_REQUESTS_FILE",
+        str(tmp_path_factory.mktemp("request-costs") / "requests.jsonl"),
+    )
+
+
+@pytest.fixture(autouse=True, scope="session")
 def _tune_store_in_tmp(tmp_path_factory):
     """The self-tuning layer's persisted store (tensorframes_tpu/tune)
     reads/writes the test session's tmp dir: tests must neither pollute
