@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-obsfleet test-plan test-spec test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-obsfleet test-plan test-spec test-tenancy test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -100,6 +100,14 @@ test-tune:
 # Fast, CPU-only, deterministic; part of tier-1
 test-spec:
 	$(PY) -m pytest tests/ -q -m spec
+
+# the multi-tenant QoS suite (serve/tenancy.py: quotas + token-bucket
+# rate limits, priority admission/preemption/eviction, SLO-actuated
+# shedding/deprioritization, 429 + /admin/tenants, the 2-replica
+# fairness soak with byte-identity vs solo) — fast, CPU-only,
+# deterministic; part of tier-1
+test-tenancy:
+	$(PY) -m pytest tests/ -q -m tenancy
 
 # the tensor-parallel serving suite (serve/tp.py: mesh-sharded step
 # programs + sharded KV PagePool — the TP=1/2/4 byte-identity matrix,

@@ -501,6 +501,78 @@ def _serve_fleet_aggregate(lm, replicas, n_requests=16, plen=32, max_new=64,
     }
 
 
+def _serve_tenants_mix(lm, plen, max_new, seed, per_class=6):
+    """The multi-tenant QoS axis (``TFT_BENCH_TENANTS``): one engine,
+    ``per_class`` interactive-class and ``per_class`` batch-class
+    requests submitted together under an enabled tenancy plane
+    (serve/tenancy.py), reporting per-class tokens/s and TTFT — the
+    number the priority-aware admission order exists to move (the
+    interactive class should see better TTFT than batch under the same
+    mixed load). Config is restored afterwards so later axes measure
+    the plane-off default."""
+    import threading
+
+    import tensorframes_tpu as tft
+    from tensorframes_tpu.serve import GenerationEngine
+
+    rng = np.random.default_rng(seed)
+    classes = ("interactive", "batch")
+    prompts = {
+        cls: [
+            rng.integers(1, 256, size=plen).astype(np.int32).tolist()
+            for _ in range(per_class)
+        ]
+        for cls in classes
+    }
+    tft.utils.set_config(tenants=(
+        {"tenant": "fg", "priority": "interactive"},
+        {"tenant": "bg", "priority": "batch"},
+    ))
+    tenant_of = {"interactive": "fg", "batch": "bg"}
+    try:
+        eng = GenerationEngine(
+            lm,
+            max_slots=per_class,  # half the load fits: admission ordering matters
+            page_size=16,
+            max_seq_len=plen + max_new,
+            queue_capacity=2 * per_class,
+        )
+        eng.generate([prompts["interactive"][0]], 2)
+        stamps = {cls: [[] for _ in range(per_class)] for cls in classes}
+
+        def consume(cls, i, handle):
+            for _ in handle:
+                stamps[cls][i].append(time.perf_counter())
+
+        with eng:
+            t0 = time.perf_counter()
+            handles = [
+                (cls, i, eng.submit(p, max_new, tenant=tenant_of[cls]))
+                for cls in classes
+                for i, p in enumerate(prompts[cls])
+            ]
+            threads = [
+                threading.Thread(target=consume, args=h) for h in handles
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+        out = {"wall_s": round(dt, 3)}
+        for cls in classes:
+            ttfts = sorted(s[0] - t0 for s in stamps[cls] if s)
+            ntok = sum(len(s) for s in stamps[cls])
+            out[cls] = {
+                "tokens_per_sec": round(ntok / dt, 1),
+                "ttft_p50_ms": round(_pct(ttfts, 0.50) * 1e3, 3),
+                "ttft_p99_ms": round(_pct(ttfts, 0.99) * 1e3, 3),
+            }
+        return out
+    finally:
+        tft.utils.set_config(tenants=())
+
+
 def _serve_tp_level(lm, degree, plen, max_new, seed, n_requests=16):
     """One tensor-parallel degree of the ``TFT_BENCH_TP`` axis: the
     concurrency-16 serving workload with ONE engine spanning ``degree``
@@ -699,6 +771,14 @@ def main_decode_serve():
     # WORST case for the pct — real-chip step times dwarf the ~µs span
     # cost)
     observability = _serve_obs_overhead(lm, plen=plen, max_new=16)
+    # the multi-tenant QoS axis (ISSUE 17): a mixed interactive+batch
+    # load under an enabled tenancy plane, per-class tok/s + TTFT.
+    # TFT_BENCH_TENANTS opts IN (default off, and the bench-check gate
+    # pins it off — the gated headline must measure the plane-off
+    # zero-cost default, which is also the byte-identity baseline).
+    tenants = {}
+    if os.environ.get("TFT_BENCH_TENANTS", "").strip():
+        tenants = _serve_tenants_mix(lm, plen=plen, max_new=32, seed=17)
     from tensorframes_tpu.utils import chaos
 
     print(
@@ -723,6 +803,7 @@ def main_decode_serve():
                     "tensor_parallel": tp_levels,
                     "speculative": speculative,
                     "observability": observability,
+                    "tenants": tenants,
                     # a chaos-tainted number must never be mistaken for a
                     # clean one (the injection sites sit on this path; the
                     # disabled check is the measured-as-free case)

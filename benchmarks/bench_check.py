@@ -66,6 +66,10 @@ GATE_ENV = {
     # must not pollute the regression baseline — the gate measures the
     # STATIC configuration, `make bench-autotune` measures tuning
     "TFT_TUNE": "0",
+    # the multi-tenant QoS axis (TFT_BENCH_TENANTS, ISSUE 17) pinned
+    # OFF: the gated headline measures the plane-off zero-cost default
+    # (also the byte-identity baseline) — `make bench-serve` can opt in
+    "TFT_BENCH_TENANTS": "",
     # fleet-telemetry export (ISSUE 16) pinned OFF: periodic snapshot
     # writes from an operator's ambient TFT_TELEMETRY_DIR must not
     # taint the gated numbers — `make bench-serve` measures the export

@@ -335,6 +335,7 @@ class ScoringServer:
         "/statusz": ("GET",),
         "/varz": ("GET",),
         "/generate": ("POST",),
+        "/admin/tenants": ("GET", "POST"),
     }
 
     @classmethod
@@ -388,7 +389,11 @@ class ScoringServer:
           stream completes. 503 + adaptive ``Retry-After`` on a full
           admission queue or an unhealthy engine / all-fenced fleet
           (shed, don't block), 504 on a missed deadline, 400 on an
-          infeasible request.
+          infeasible request, 429 + ``Retry-After`` when the tenant's
+          QoS policy refuses it (quota / rate / SLO shed);
+        - ``GET|POST /admin/tenants`` — the QoS policy registry
+          (``serve/tenancy.py``): read or update per-tenant quotas,
+          rate limits, and priority classes at runtime.
 
         Unknown paths answer 404; known paths with the wrong verb 405
         with an ``Allow`` header. Returns the request kind for the
@@ -434,7 +439,7 @@ class ScoringServer:
             # instead of falling through to an ambiguous catch-all
             out = (
                 b"endpoints: GET /metrics, GET /healthz, GET /statusz, "
-                b"GET /varz, POST /generate\n"
+                b"GET /varz, POST /generate, GET|POST /admin/tenants\n"
             )
             status = "404 Not Found"
         elif verb not in allowed:
@@ -460,6 +465,12 @@ class ScoringServer:
         elif norm == "/varz":
             kind = "varz"
             status, out, extra_headers = self._handle_varz(query)
+            ctype = "application/json; charset=utf-8"
+        elif norm == "/admin/tenants":
+            kind = "admin"
+            status, out, extra_headers = self._handle_admin_tenants(
+                verb, body
+            )
             ctype = "application/json; charset=utf-8"
         else:  # /generate, POST
             kind = "generate"
@@ -680,10 +691,26 @@ class ScoringServer:
             "identity": identity_view,
             "request_costs": costs_view,
             "fleet": fleet_view,
+            # the QoS plane's per-tenant view (None with no policies
+            # configured): policies, live slots/queue share, recent
+            # tokens/s + est FLOPs from the cost ledger, throttles —
+            # read-side aggregation only (serve/tenancy.py)
+            "tenants": self._tenants_view(),
         }
         return "200 OK", json.dumps(payload, default=str).encode(
             "utf-8"
         ), {}
+
+    def _tenants_view(self):
+        """The QoS plane's ``/statusz`` block (None when off);
+        exceptions degrade to an ``"error"`` stub — the status page
+        always renders."""
+        try:
+            from ..serve import tenancy as _tenancy
+
+            return _tenancy.statusz_view(self._engine)
+        except Exception as e:  # pragma: no cover - defensive
+            return {"error": f"{type(e).__name__}: {e}"}
 
     def _serving_view(self):
         """The engine's (or fleet's) ``health()`` snapshot for
@@ -696,6 +723,53 @@ class ScoringServer:
             return self._engine.health()
         except Exception as e:  # pragma: no cover - defensive
             return {"error": f"{type(e).__name__}: {e}"}
+
+    @staticmethod
+    def _handle_admin_tenants(
+        verb: str, body: bytes
+    ) -> Tuple[str, bytes, Dict[str, str]]:
+        """``/admin/tenants`` — the QoS policy registry
+        (``serve/tenancy.py``). GET returns the live policies plus the
+        plane/shedding state; POST applies one of three shapes (a
+        single policy object → upsert, ``{"tenant": x, "delete":
+        true}`` → remove, ``{"tenants": [...]}`` → replace all — ``[]``
+        turns the plane off) through ``set_config``, so every consumer
+        (scheduler order, admission buckets, placement) flips
+        atomically. Validation errors are 400s; nothing changes on a
+        rejected body."""
+        import json
+
+        from ..serve import tenancy as _tenancy
+
+        if verb == "GET":
+            payload = {
+                "enabled": _tenancy.enabled(),
+                "shedding": _tenancy.shedding(),
+                "tenants": _tenancy.policies_view(),
+            }
+            return (
+                "200 OK",
+                json.dumps(payload).encode("utf-8"),
+                {},
+            )
+        try:
+            spec = json.loads(body.decode("utf-8") or "{}")
+            tenants = _tenancy.apply_admin(spec)
+        except (ValueError, TypeError, KeyError) as e:
+            return (
+                "400 Bad Request",
+                json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}
+                ).encode("utf-8"),
+                {},
+            )
+        return (
+            "200 OK",
+            json.dumps(
+                {"enabled": _tenancy.enabled(), "tenants": tenants}
+            ).encode("utf-8"),
+            {},
+        )
 
     @staticmethod
     def _handle_varz(query: str = "") -> Tuple[str, bytes, Dict[str, str]]:
@@ -862,6 +936,7 @@ class ScoringServer:
         from ..serve.engine import EngineUnhealthyError
         from ..serve.scheduler import QueueFullError
         from ..utils.config import get_config
+        from ..utils.failures import TenantThrottledError
 
         try:
             spec = json.loads(body.decode("utf-8") or "{}")
@@ -915,6 +990,20 @@ class ScoringServer:
             # placement (DeadlineExceededError) — same 504 as a stream
             # that expired mid-generation
             return reply("504 Gateway Timeout", {"error": str(e)})
+        except TenantThrottledError as e:
+            # per-TENANT refusal (quota / rate bucket / SLO shed,
+            # serve/tenancy.py) — the server has capacity, this tenant
+            # may not use it: 429, not the all-full 503. Retry-After is
+            # the refusing token bucket's refill time, clamped to the
+            # same [1, 30] window the adaptive 503 hint uses.
+            import math
+
+            retry = str(int(min(30, max(1, math.ceil(e.retry_after)))))
+            return reply(
+                "429 Too Many Requests",
+                {"error": str(e), "tenant": e.tenant, "reason": e.reason},
+                {"Retry-After": retry},
+            )
         except (QueueFullError, EngineUnhealthyError) as e:
             # overload shedding: the caller can retry, THIS server can't
             # help right now — answer fast instead of parking the

@@ -431,6 +431,19 @@ def sample_once(now: Optional[float] = None) -> int:
     except Exception:
         logger.warning("program-registry persistence failed", exc_info=True)
     try:
+        # SLO-actuated QoS: act on this tick's freshly-evaluated burn
+        # state (shed/deprioritize/recover). Only when the tenancy
+        # module is ALREADY imported — a serving process has it via the
+        # scheduler; a batch-only sampler must not drag the serve stack
+        # (and jax programs) in just to tick a no-op hook.
+        import sys as _sys
+
+        _tenancy = _sys.modules.get("tensorframes_tpu.serve.tenancy")
+        if _tenancy is not None:
+            _tenancy.slo_tick(now=now)
+    except Exception:
+        logger.warning("tenancy SLO tick failed", exc_info=True)
+    try:
         from . import export as _export
 
         _export.autoexport(now=now)

@@ -95,12 +95,14 @@ from ..utils.config import get_config
 from ..utils.failures import (
     DeadlineExceededError,
     PagePoolExhausted,
+    TenantThrottledError,
     first_line as _first_line,
     is_oom,
     is_transient,
     run_with_retries,
 )
 from ..utils.logging import get_logger
+from . import tenancy as _tenancy
 from .kv_pages import PagePool, PrefixCache, pages_needed
 from .scheduler import (
     GenerationHandle,
@@ -1305,6 +1307,23 @@ class GenerationEngine:
                 "or a wedged stop; restart() it (or recycle the process) "
                 "before submitting"
             )
+        if _handle_factory is None and _tenancy.enabled():
+            # the QoS admission gate (quota / rate / SLO shed → 429).
+            # Only at the FRONT door: the fleet router charged its
+            # fleet-wide check already, so the relay path
+            # (_handle_factory set) must not bill the tenant twice —
+            # and preemption requeues / failover replays never come
+            # back through submit at all
+            active, queued = self.scheduler.tenant_counts()
+            key = str(tenant or "")
+            try:
+                _tenancy.admit_request(
+                    key, int(max_new_tokens),
+                    active.get(key, 0), queued.get(key, 0),
+                )
+            except TenantThrottledError:
+                _m_requests.inc(status="rejected")
+                raise
         with self._submit_lock:
             self._req_counter += 1
             rid = self._req_counter
@@ -1325,6 +1344,7 @@ class GenerationEngine:
             ),
             trace=trace if trace is not None else _current_trace(),
             tenant=str(tenant or ""),
+            priority=_tenancy.priority_of(str(tenant or "")),
         )
         try:
             self.scheduler.submit(req, block=block, timeout=timeout)
@@ -1454,7 +1474,7 @@ class GenerationEngine:
             self._consecutive_ooms,
         )
         self._defragment_locked()
-        victim = self.scheduler._youngest_active(exclude=-1)
+        victim = self.scheduler._victim_slot(exclude=-1)
         if victim is not None:
             self.scheduler.preempt(victim)
         return True
@@ -1582,7 +1602,10 @@ class GenerationEngine:
         """A finished prefill publishes its prompt's complete pages for
         future identical prefixes to share."""
         if self.prefix_cache is not None:
-            self.prefix_cache.insert(act.req.prompt, act.seq.pages)
+            self.prefix_cache.insert(
+                act.req.prompt, act.seq.pages,
+                priority=act.req.priority,
+            )
 
     def _advance_prefill(self, idx: int, act: _Active) -> None:
         """Dispatch ONE prefill chunk (the third compiled program); on
@@ -1757,6 +1780,15 @@ class GenerationEngine:
             self.max_seq_len - act.length,
         )
         k = max(0, k)
+        if k > 1:
+            # QoS: low-priority slots surrender speculative page
+            # appetite first under pool pressure (identity when the
+            # plane is off). Acceptance is exact-match, so a shorter
+            # k never changes emitted bytes.
+            k = _tenancy.clamp_spec_k(
+                k, act.req.priority,
+                self.pool.pages_free, self.pool.num_pages,
+            )
         if k > 0:
             try:
                 act.seq.ensure(act.length + k)
@@ -2042,6 +2074,8 @@ class GenerationEngine:
         )
         _m_pages_in_use.set(float(self.pool.pages_in_use))
         _m_pages_shared.set(float(self.pool.pages_shared))
+        if _tenancy.enabled():
+            _tenancy.update_active_gauge(self.scheduler.slots)
 
     def run_until_idle(self) -> None:
         """Drive :meth:`step` until queue and slots are empty (the

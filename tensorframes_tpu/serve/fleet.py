@@ -64,7 +64,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Deque, Dict, List, Optional, Sequence
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -79,10 +79,12 @@ from ..utils import chaos as _chaos
 from ..utils.config import get_config
 from ..utils.failures import (
     DeadlineExceededError,
+    TenantThrottledError,
     first_line as _first_line,
     run_with_retries,
 )
 from ..utils.logging import get_logger
+from . import tenancy as _tenancy
 from .engine import EngineUnhealthyError, GenerationEngine
 from .scheduler import GenerationHandle, QueueFullError
 
@@ -373,7 +375,12 @@ class Fleet:
         self._req_counter = 0
         self._inflight: Dict[int, _FleetRequest] = {}
         self._pending: Deque[_FleetRequest] = deque()
-        self._sessions: "OrderedDict[str, _Replica]" = OrderedDict()
+        #: session key -> (pinned replica, tenant) — the tenant rides
+        #: along so the SLO actuator can drop one tenant's pins
+        #: (:meth:`replace_tenant_sessions`) without scanning requests
+        self._sessions: "OrderedDict[str, Tuple[_Replica, str]]" = (
+            OrderedDict()
+        )
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._wake = threading.Event()
@@ -440,13 +447,32 @@ class Fleet:
 
     # -- placement ---------------------------------------------------------
 
-    def _candidates(self, session: Optional[str] = None) -> List[_Replica]:
+    @staticmethod
+    def _tenant_slots(rep: _Replica, tenant: str) -> int:
+        """This tenant's live decode slots on one replica (lock-free
+        sweep of the slot list — the same stale-tolerant read the
+        pages_free/queue_depth placement keys already are)."""
+        return sum(
+            1
+            for a in rep.engine.scheduler.slots
+            if a is not None and a.req.tenant == tenant
+        )
+
+    def _candidates(
+        self,
+        session: Optional[str] = None,
+        tenant: Optional[str] = None,
+    ) -> List[_Replica]:
         """Active, healthy replicas in placement-preference order:
         session-affine replica first (when mapped and still eligible),
         then least-loaded — most free KV pages, then shallowest queue,
-        then name (a deterministic tiebreak). Raises
-        :class:`EngineUnhealthyError` when every replica is fenced —
-        the ALL-replicas-down shed the endpoint maps to 503."""
+        then name (a deterministic tiebreak). With the QoS plane on and
+        a tenant named, replicas holding FEWER of that tenant's active
+        slots come first (ahead of raw load): one tenant's flood piles
+        onto the replicas it already occupies instead of spreading to
+        monopolize every pool. Raises :class:`EngineUnhealthyError`
+        when every replica is fenced — the ALL-replicas-down shed the
+        endpoint maps to 503."""
         _chaos.site("fleet.place")
         cands = [
             rep
@@ -460,29 +486,76 @@ class Fleet:
                 "all fleet replicas are fenced or unhealthy; the watchdog "
                 "is restarting them — retry shortly"
             )
-        cands.sort(
-            key=lambda rep: (
-                -rep.engine.pool.pages_free,
-                rep.engine.scheduler.queue_depth,
-                rep.name,
+        if tenant and _tenancy.enabled():
+            cands.sort(
+                key=lambda rep: (
+                    self._tenant_slots(rep, tenant),
+                    -rep.engine.pool.pages_free,
+                    rep.engine.scheduler.queue_depth,
+                    rep.name,
+                )
             )
-        )
+        else:
+            cands.sort(
+                key=lambda rep: (
+                    -rep.engine.pool.pages_free,
+                    rep.engine.scheduler.queue_depth,
+                    rep.name,
+                )
+            )
         if session is not None:
             with self._lock:
-                sticky = self._sessions.get(session)
-                if sticky is not None:
+                entry = self._sessions.get(session)
+                if entry is not None:
                     self._sessions.move_to_end(session)
+            sticky = entry[0] if entry is not None else None
             if sticky is not None and sticky in cands:
                 cands.remove(sticky)
                 cands.insert(0, sticky)
         return cands
 
-    def _remember_session(self, session: str, rep: _Replica) -> None:
+    def _remember_session(
+        self, session: str, rep: _Replica, tenant: str = ""
+    ) -> None:
         with self._lock:
-            self._sessions[session] = rep
+            self._sessions[session] = (rep, tenant)
             self._sessions.move_to_end(session)
             while len(self._sessions) > _MAX_SESSIONS:
                 self._sessions.popitem(last=False)
+
+    def replace_tenant_sessions(self, tenant: str) -> int:
+        """Drop every session→replica pin whose traffic bills to
+        ``tenant`` (the SLO actuator's sustained-burn re-placement):
+        the tenant's NEXT requests place least-loaded instead of
+        sticking to the replicas they saturated. In-flight streams are
+        untouched — placement moves, bytes don't. Returns the number
+        of pins dropped."""
+        with self._lock:
+            victims = [
+                s for s, (_, t) in self._sessions.items() if t == tenant
+            ]
+            for s in victims:
+                del self._sessions[s]
+        if victims:
+            _flight.record(
+                "fleet", "replace_sessions", tenant=tenant,
+                sessions=len(victims),
+            )
+        return len(victims)
+
+    def tenant_counts(self) -> Tuple[dict, dict]:
+        """Fleet-wide per-tenant footprint: active slots and queued
+        requests summed across replicas (the QoS quota input and the
+        ``/statusz`` per-tenant view)."""
+        active: dict = {}
+        queued: dict = {}
+        for rep in self._replicas:
+            a, q = rep.engine.scheduler.tenant_counts()
+            for t, n in a.items():
+                active[t] = active.get(t, 0) + n
+            for t, n in q.items():
+                queued[t] = queued.get(t, 0) + n
+        return active, queued
 
     def _submit_to(self, rep: _Replica, rec: _FleetRequest) -> None:
         """One engine submission for ``rec`` on ``rep``, recompute-style:
@@ -576,6 +649,17 @@ class Fleet:
                 f"max_new_tokens must be >= 1; got {max_new_tokens}"
             )
         prompt = np.asarray(prompt, np.int32).ravel()
+        tenant_key = str(tenant if tenant is not None else (session or ""))
+        if _tenancy.enabled():
+            # the fleet-wide QoS gate, charged ONCE here: the replica
+            # engines skip their own check on the relay path
+            # (_handle_factory set), so a request is never billed
+            # twice, and failover replays never re-enter this method
+            active, queued = self.tenant_counts()
+            _tenancy.admit_request(
+                tenant_key, int(max_new_tokens),
+                active.get(tenant_key, 0), queued.get(tenant_key, 0),
+            )
         with self._id_lock:
             self._req_counter += 1
             rid = self._req_counter
@@ -590,7 +674,7 @@ class Fleet:
             None if deadline is None else time.monotonic() + float(deadline),
             session,
             FleetHandle(rid),
-            tenant=str(tenant if tenant is not None else (session or "")),
+            tenant=tenant_key,
         )
         # one trace_id for the request's whole life, however many
         # replicas serve it (the HTTP handler installs the traceparent's
@@ -600,7 +684,8 @@ class Fleet:
         t_end = None if timeout is None else time.monotonic() + timeout
         while True:
             cands = run_with_retries(
-                lambda: self._candidates(session), what="fleet.place"
+                lambda: self._candidates(session, tenant_key),
+                what="fleet.place",
             )
             exhausted = None
             for rep in cands:
@@ -629,7 +714,7 @@ class Fleet:
                     if not rec.handle.done:
                         self._inflight[rid] = rec
                 if session is not None:
-                    self._remember_session(session, rep)
+                    self._remember_session(session, rep, tenant_key)
                 _m_placements.inc(replica=rep.name)
                 return rec.handle
             if exhausted is None:
@@ -680,10 +765,15 @@ class Fleet:
     @staticmethod
     def _replayable(error: BaseException) -> bool:
         """Replica deaths replay; the request's own terminal conditions
-        do not: a passed deadline is passed everywhere, and an
-        infeasible request (``ValueError``) is infeasible on every
-        identical replica."""
-        return not isinstance(error, (DeadlineExceededError, ValueError))
+        do not: a passed deadline is passed everywhere, an infeasible
+        request (``ValueError``) is infeasible on every identical
+        replica, and a QoS throttle (``TenantThrottledError``) refused
+        the TENANT — replaying would re-run work the admission gate
+        rejected."""
+        return not isinstance(
+            error,
+            (DeadlineExceededError, ValueError, TenantThrottledError),
+        )
 
     def _on_inner_finish(
         self,
@@ -1087,6 +1177,9 @@ class Fleet:
                 rep.engine.start()
         self._thread = threading.Thread(target=self._supervise, daemon=True)
         self._thread.start()
+        # the SLO actuator's session re-placement hook (weakly held —
+        # a stopped/collected fleet unregisters itself)
+        _tenancy.register_fleet(self)
         return self
 
     def _supervise(self) -> None:
@@ -1116,6 +1209,7 @@ class Fleet:
             # registers BEFORE this flag (and gets drained below) or
             # observes it at registration and sheds
             self._closed = True
+        _tenancy.register_fleet(None)
         self._stop_evt.set()
         self._wake.set()
         if self._thread is not None:

@@ -48,6 +48,7 @@ import numpy as np
 
 from ..utils import chaos as _chaos
 from ..utils.failures import PagePoolExhausted
+from . import tenancy as _tenancy
 
 __all__ = [
     "PageGroup",
@@ -446,13 +447,19 @@ class _PrefixEntry:
     each). ``keys`` are the per-page-count digests registered in the
     lookup index, kept so eviction can remove exactly its own keys."""
 
-    __slots__ = ("tokens", "pages", "keys", "full_key")
+    __slots__ = ("tokens", "pages", "keys", "full_key", "priority")
 
-    def __init__(self, tokens: np.ndarray, pages: List[int]):
+    def __init__(
+        self, tokens: np.ndarray, pages: List[int], priority: int = 1
+    ):
         self.tokens = tokens
         self.pages = pages
         self.keys: List[bytes] = []
         self.full_key: bytes = b""
+        #: highest tenant-priority rank that registered this prefix
+        #: (``serve/tenancy.py``): priority-weighted eviction drops
+        #: low-rank entries first when the QoS plane is on
+        self.priority = int(priority)
 
 
 class PrefixCache:
@@ -516,12 +523,19 @@ class PrefixCache:
 
     # -- registration ------------------------------------------------------
 
-    def insert(self, prompt: np.ndarray, pages: Sequence[int]) -> bool:
+    def insert(
+        self,
+        prompt: np.ndarray,
+        pages: Sequence[int],
+        priority: int = 1,
+    ) -> bool:
         """Register a prefilled prompt's COMPLETE pages (``len(prompt) //
         page_size`` of them — a partial trailing page is still mutable
         and never shared). Takes one pool reference per page; idempotent
-        for an already-registered prompt (LRU touch only). Returns
-        whether a new entry was created."""
+        for an already-registered prompt (LRU touch only, and the entry
+        keeps the HIGHEST priority any registrant gave it — a prefix an
+        interactive tenant shares must not evict on a batch tenant's
+        rank). Returns whether a new entry was created."""
         prompt = np.asarray(prompt, np.int32).ravel()
         k_full = len(prompt) // self.page_size
         if k_full < 1:
@@ -530,9 +544,13 @@ class PrefixCache:
         full_key = self._key(tokens)
         with self._lock:
             if full_key in self._entries:
+                ent = self._entries[full_key]
+                ent.priority = max(ent.priority, int(priority))
                 self._entries.move_to_end(full_key)
                 return False
-            ent = _PrefixEntry(tokens, [int(p) for p in pages[:k_full]])
+            ent = _PrefixEntry(
+                tokens, [int(p) for p in pages[:k_full]], priority
+            )
             self.pool.ref(ent.pages)
             ent.full_key = full_key
             for k in range(1, k_full + 1):
@@ -626,6 +644,21 @@ class PrefixCache:
         should fall through to preemption."""
         freed = 0
         with self._lock:
+            if _tenancy.enabled():
+                # priority-weighted: low-rank tenants' prefixes pay
+                # first; the sort is stable over insertion order, so
+                # WITHIN a rank eviction stays exactly LRU. QoS off
+                # takes the plain-LRU loop below, byte-identical to
+                # the pre-tenancy cache.
+                order = sorted(
+                    self._entries.values(),
+                    key=lambda ent: ent.priority,
+                )
+                for ent in order:
+                    if freed >= need:
+                        break
+                    freed += self._drop_locked(ent.full_key)
+                return freed
             while freed < need and self._entries:
                 freed += self._drop_locked(next(iter(self._entries)))
         return freed

@@ -44,6 +44,7 @@ from ..utils.failures import (
     PagePoolExhausted,
     record_preemption,
 )
+from . import tenancy as _tenancy
 from .kv_pages import PagePool, SequencePages, pages_needed
 
 __all__ = [
@@ -155,6 +156,11 @@ class GenRequest:
     #: billed to. The serving layer defaults it to the fleet session id
     #: when the client names no tenant; empty means unattributed.
     tenant: str = ""
+    #: scheduling rank from the tenant's QoS policy at submission
+    #: (``serve/tenancy.py`` ``PRIORITIES``: 0 batch, 1 standard,
+    #: 2 interactive). With the QoS plane off every request carries the
+    #: default 1 and ordering degenerates to pure FIFO.
+    priority: int = 1
 
 
 class _Active:
@@ -330,7 +336,20 @@ class Scheduler:
             with self._lock:
                 if not self._waiting:
                     break
-                req = self._waiting.popleft()
+                if _tenancy.enabled():
+                    # (priority, arrival): highest class first, and
+                    # WITHIN a class the frontmost queue position —
+                    # deque order is the arrival proxy, so preempted
+                    # requests (requeued at the front) keep their
+                    # earned seniority
+                    best = max(
+                        range(len(self._waiting)),
+                        key=lambda j: (self._waiting[j].priority, -j),
+                    )
+                    req = self._waiting[best]
+                    del self._waiting[best]
+                else:
+                    req = self._waiting.popleft()
                 self._lock.notify_all()
             seq = SequencePages(self.pool)
             cow_src: Optional[int] = None
@@ -392,7 +411,7 @@ class Scheduler:
                     and self.prefix_cache.evict_pages(1) > 0
                 ):
                     continue  # a cold cached prefix paid instead
-                victim_idx = self._youngest_active(exclude=idx)
+                victim_idx = self._victim_slot(exclude=idx)
                 if victim_idx is None:
                     # nothing left to evict but the requester itself; its
                     # full-length feasibility was checked at submit, so
@@ -415,6 +434,39 @@ class Scheduler:
             if a.admit_order > best_order:
                 best, best_order = i, a.admit_order
         return best
+
+    def _victim_slot(self, exclude: int) -> Optional[int]:
+        """The preemption victim other than ``exclude``. QoS plane off:
+        exactly :meth:`_youngest_active`. Plane on: lowest-PRIORITY
+        slot first, youngest within a class — an interactive stream is
+        never evicted while a batch slot can pay, and within one class
+        the least progress is lost (still starvation-free: victims
+        requeue at the front and re-admit ahead of their class)."""
+        if not _tenancy.enabled():
+            return self._youngest_active(exclude)
+        best: Optional[int] = None
+        best_key: Optional[Tuple[int, int]] = None
+        for i, a in enumerate(self.slots):
+            if a is None or i == exclude:
+                continue
+            key = (a.req.priority, -a.admit_order)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def tenant_counts(self) -> Tuple[dict, dict]:
+        """Per-tenant footprint: ({tenant: active slots},
+        {tenant: queued requests}) — the admission gate's quota input
+        and the ``/statusz`` per-tenant view."""
+        active: dict = {}
+        with self._lock:
+            for a in self.slots:
+                if a is not None:
+                    active[a.req.tenant] = active.get(a.req.tenant, 0) + 1
+            queued: dict = {}
+            for r in self._waiting:
+                queued[r.tenant] = queued.get(r.tenant, 0) + 1
+        return active, queued
 
     def preempt(self, idx: int) -> GenRequest:
         """Evict slot ``idx``: release its pages and requeue the request
@@ -442,8 +494,10 @@ class Scheduler:
             deadline_t=req.deadline_t,
             trace=req.trace,
             tenant=req.tenant,
+            priority=req.priority,
         )
         record_preemption("serve")
+        _tenancy.count_preemption(req.priority)
         self._requeue_front(new_req)
         return new_req
 

@@ -1,0 +1,679 @@
+"""Multi-tenant QoS plane: quotas, priorities, and SLO-actuated control.
+
+PR 16 threaded a ``tenant`` label through ``Fleet.submit`` →
+``GenRequest`` → the per-request cost ledger, but it stayed an
+accounting tag: the scheduler was single-tenant FIFO and the SLO
+burn-rate monitors (``obs/slo.py``) only flipped ``/healthz``. This
+module turns the label into an enforcement boundary. It owns:
+
+- the per-tenant :class:`TenantPolicy` registry (``Config.tenants`` /
+  ``set_config`` / ``POST /admin/tenants``): admission quota (max
+  concurrent slots + queued requests), token-bucket rate limits on
+  requests/s and generated tokens/s, a priority class
+  ``batch | standard | interactive``, and an optional per-tenant TTFT
+  SLO surfaced on ``/statusz``;
+- **admission control** (:func:`admit_request`): over-quota /
+  rate-limited / shed requests raise
+  :class:`~tensorframes_tpu.utils.failures.TenantThrottledError`
+  (HTTP 429 with an adaptive ``Retry-After`` = the bucket's refill
+  time) *before* any engine state is touched — distinct from the
+  all-full 503, never retried, never replayed;
+- **priority answers** for the scheduler/pool layers
+  (:func:`priority_of`, :func:`clamp_spec_k`): admission ordering
+  becomes (priority, arrival), ``PagePoolExhausted`` preemption becomes
+  preempt-lowest-priority-then-youngest, prefix-cache eviction drops
+  low-priority entries first, and speculation shrinks k for
+  low-priority slots under pool pressure;
+- the **SLO actuator** (:func:`slo_tick`, riding the time-series
+  sampler tick right after ``slo.monitor().evaluate``): a fast burn
+  sheds ``batch``-class admissions; a sustained burn deprioritizes the
+  top-cost tenant (from the ``obs/requests.py`` ledger) and asks the
+  fleet router to re-place its sessions onto the least-loaded replica;
+  recovery re-admits. Every action increments
+  ``slo.actions_total{action}`` and lands in the ``tenancy`` flight
+  ring.
+
+**The byte-identity contract is untouched.** QoS decides *which*
+request runs *when* and *where* — scheduling order, preemption victims,
+eviction order, placement, speculative depth — never what tokens a
+request produces: any admitted stream is byte-identical to the same
+request on an unloaded single-tenant engine, greedy and seeded, under
+preemption, restart, and failover.
+
+**Off is free.** With no policies configured (the default) ``_ON``
+stays False — a module global refreshed by the ``set_config`` callback
+hook (the TFT_OBS / chaos pattern) — and every hook returns on one
+boolean check: scheduler order, preemption choice, placement, and all
+emitted streams are byte-identical to the pre-tenancy engine.
+
+See docs/serving_llm.md "Multi-tenancy".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from ..obs import flight as _flight
+from ..obs.metrics import counter as _counter
+from ..obs.metrics import gauge as _gauge
+from ..utils import chaos as _chaos
+from ..utils.config import get_config, register_on_change, set_config
+from ..utils.failures import TenantThrottledError
+from ..utils.logging import get_logger
+
+__all__ = [
+    "PRIORITIES",
+    "TenantPolicy",
+    "admit_request",
+    "apply_admin",
+    "clamp_spec_k",
+    "count_preemption",
+    "enabled",
+    "policies_view",
+    "priority_of",
+    "register_fleet",
+    "shedding",
+    "slo_tick",
+    "statusz_view",
+    "update_active_gauge",
+]
+
+logger = get_logger("tenancy")
+
+#: priority classes, ordered: higher rank wins admission, lower rank is
+#: preempted / shed / spec-shrunk first. Unknown tenants are
+#: ``standard`` (rank 1) — exactly the single-tenant behavior.
+PRIORITIES = {"batch": 0, "standard": 1, "interactive": 2}
+_RANK_NAMES = {rank: name for name, rank in PRIORITIES.items()}
+_DEFAULT_RANK = PRIORITIES["standard"]
+
+#: how long a sustained-burn deprioritization of the top-cost tenant
+#: holds (and the minimum spacing between successive deprioritize
+#: actions — one tenant at a time, re-judged after the hold)
+_DEPRI_HOLD_S = 30.0
+#: Retry-After hint for SLO-shed admissions: there is no bucket to
+#: compute a refill time from, so advertise the order of an SLO window
+_SHED_RETRY_S = 5.0
+
+_m_active_slots = _gauge(
+    "serve.tenant_active_slots",
+    "Decode slots currently held, by tenant (QoS plane on only)",
+    labels=("tenant",),
+)
+_m_throttled = _counter(
+    "serve.tenant_throttled_total",
+    "Admissions refused by the QoS plane (HTTP 429), by tenant and "
+    "gate (quota | rate | shed)",
+    labels=("tenant", "reason"),
+)
+_m_preemptions = _counter(
+    "serve.preemptions_total",
+    "Serving preempt-and-requeues by the victim's priority class "
+    "(failures.preemptions_total keeps the per-op total)",
+    labels=("priority",),
+)
+_m_actions = _counter(
+    "slo.actions_total",
+    "SLO-actuated QoS control actions (shed_batch | deprioritize | "
+    "replace_sessions | recover)",
+    labels=("action",),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's QoS contract. Every limit is optional; 0 means
+    unlimited / none — a policy carrying only ``priority`` is purely a
+    scheduling-class assignment."""
+
+    tenant: str
+    #: ``batch | standard | interactive`` (see :data:`PRIORITIES`)
+    priority: str = "standard"
+    #: admission quota: max concurrent decode slots + max queued
+    #: admissions. Enforced as one bound on (active + queued) — the
+    #: tenant's total footprint in the engine — because a queued
+    #: request becomes an active one without re-admission.
+    max_active: int = 0
+    max_queued: int = 0
+    #: token-bucket rate limits (sustained; burst = 1 s of rate)
+    requests_per_s: float = 0.0
+    tokens_per_s: float = 0.0
+    #: advisory per-tenant TTFT objective, seconds — surfaced on
+    #: ``/statusz`` (recent p99 vs bound from the cost ledger), not an
+    #: admission gate
+    ttft_slo_s: float = 0.0
+
+    @property
+    def rank(self) -> int:
+        return PRIORITIES[self.priority]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class _Bucket:
+    """Token bucket: ``rate`` units/s refill, 1 s of burst. A take
+    charges its full cost (the level may go negative — a single
+    over-burst request is admitted against future refill, enforcing the
+    *sustained* rate without deadlocking on requests larger than the
+    burst), but only when the level covers ``min(cost, burst)``."""
+
+    __slots__ = ("rate", "burst", "level", "t")
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+        self.burst = max(self.rate, 1.0)
+        self.level = self.burst
+        self.t = time.monotonic()
+
+    def _refill(self, now: float) -> None:
+        # a caller-supplied clock must never DRAIN the bucket (refill is
+        # monotonic): clamp regressions to zero elapsed
+        elapsed = max(0.0, now - self.t)
+        self.level = min(self.burst, self.level + elapsed * self.rate)
+        self.t = now
+
+    def try_take(self, cost: float, now: Optional[float] = None) -> float:
+        """Charge ``cost``; returns 0.0 on success, else the seconds
+        until the bucket expects to cover it (the 429 Retry-After)."""
+        if self.rate <= 0:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        self._refill(now)
+        need = min(cost, self.burst)
+        if self.level >= need:
+            self.level -= cost
+            return 0.0
+        return (need - self.level) / self.rate
+
+
+class _TenantState:
+    """Mutable per-tenant runtime state beside the frozen policy."""
+
+    __slots__ = ("req_bucket", "tok_bucket", "depri_until", "throttles")
+
+    def __init__(self, policy: Optional[TenantPolicy]):
+        self.req_bucket = _Bucket(policy.requests_per_s if policy else 0.0)
+        self.tok_bucket = _Bucket(policy.tokens_per_s if policy else 0.0)
+        #: monotonic deadline while the SLO actuator holds this tenant
+        #: at rank 0 (sustained-burn top-cost deprioritization)
+        self.depri_until = 0.0
+        self.throttles: Dict[str, int] = {}
+
+
+_lock = threading.Lock()
+_ON = False
+_policies: Dict[str, TenantPolicy] = {}
+_states: Dict[str, _TenantState] = {}
+
+#: SLO actuator state: shedding flips on the breach transition and off
+#: on recovery; _next_depri_t rate-limits deprioritize actions
+_shed_active = False
+_next_depri_t = 0.0
+
+#: the fleet router registered for session re-placement (weak — the
+#: plane must never keep a stopped fleet alive)
+_fleet_ref: "weakref.ref | None" = None
+
+
+def _parse_policy(spec: Any) -> TenantPolicy:
+    if isinstance(spec, TenantPolicy):
+        return spec
+    if not isinstance(spec, dict) or not str(spec.get("tenant", "")):
+        raise ValueError(
+            "each Config.tenants entry must be a dict with a non-empty "
+            f"'tenant' key, got {spec!r}"
+        )
+    known = {f.name for f in dataclasses.fields(TenantPolicy)}
+    unknown = set(spec) - known
+    if unknown:
+        raise ValueError(
+            f"unknown tenant-policy field(s) {sorted(unknown)}; "
+            f"expected a subset of {sorted(known)}"
+        )
+    pol = TenantPolicy(
+        tenant=str(spec["tenant"]),
+        priority=str(spec.get("priority", "standard")),
+        max_active=int(spec.get("max_active", 0) or 0),
+        max_queued=int(spec.get("max_queued", 0) or 0),
+        requests_per_s=float(spec.get("requests_per_s", 0.0) or 0.0),
+        tokens_per_s=float(spec.get("tokens_per_s", 0.0) or 0.0),
+        ttft_slo_s=float(spec.get("ttft_slo_s", 0.0) or 0.0),
+    )
+    if pol.priority not in PRIORITIES:
+        raise ValueError(
+            f"tenant {pol.tenant!r}: priority must be one of "
+            f"{sorted(PRIORITIES)}, got {pol.priority!r}"
+        )
+    if pol.max_active < 0 or pol.max_queued < 0:
+        raise ValueError(f"tenant {pol.tenant!r}: quotas must be >= 0")
+    if pol.requests_per_s < 0 or pol.tokens_per_s < 0 or pol.ttft_slo_s < 0:
+        raise ValueError(f"tenant {pol.tenant!r}: rates must be >= 0")
+    return pol
+
+
+def _refresh() -> None:
+    """Rebuild the policy registry from the live config (the
+    ``register_on_change`` hook). Bucket/throttle state survives for
+    tenants whose policy persists across the change *unless their rates
+    changed* (a retuned limit starts from a full bucket); removed
+    tenants drop. With no policies the whole plane turns off and the
+    actuator state resets."""
+    global _ON, _shed_active
+    policies = {}
+    for spec in get_config().tenants or ():
+        pol = _parse_policy(spec)
+        policies[pol.tenant] = pol
+    with _lock:
+        for name in list(_states):
+            if name not in policies:
+                del _states[name]
+                continue
+            old = _policies.get(name)
+            new = policies[name]
+            if old is None or (
+                old.requests_per_s != new.requests_per_s
+                or old.tokens_per_s != new.tokens_per_s
+            ):
+                _states[name] = _TenantState(new)
+        _policies.clear()
+        _policies.update(policies)
+        if not policies:
+            _shed_active = False
+    _ON = bool(policies)
+
+
+register_on_change(_refresh)
+
+
+def enabled() -> bool:
+    """True when any tenant policy is configured (the plane is live)."""
+    return _ON
+
+
+def shedding() -> bool:
+    """True while the SLO actuator is shedding batch-class admissions."""
+    return _shed_active
+
+
+def _state(tenant: str) -> _TenantState:
+    """The tenant's runtime state, created lazily (callers hold no
+    policy requirement: the actuator can deprioritize an unregistered
+    tenant). Callers must hold ``_lock``."""
+    st = _states.get(tenant)
+    if st is None:
+        st = _states[tenant] = _TenantState(_policies.get(tenant))
+    return st
+
+
+def priority_of(tenant: str) -> int:
+    """The tenant's effective scheduling rank right now: the policy's
+    class, forced to 0 (batch) while the SLO actuator holds a
+    deprioritization on it. Rank 1 (standard) with the plane off or for
+    unknown tenants — the exact single-tenant behavior."""
+    if not _ON:
+        return _DEFAULT_RANK
+    with _lock:
+        pol = _policies.get(tenant)
+        st = _states.get(tenant)
+        if st is not None and st.depri_until > time.monotonic():
+            return 0
+        return pol.rank if pol is not None else _DEFAULT_RANK
+
+
+def admit_request(
+    tenant: str,
+    new_tokens: int,
+    active: int,
+    queued: int,
+) -> None:
+    """The admission gate, called once per request at the submission
+    boundary (engine front door or fleet router — never on the fleet →
+    replica relay, preemption requeues, or failover replays, so a
+    request is charged exactly once). ``active``/``queued`` are the
+    tenant's current footprint. Raises
+    :class:`~tensorframes_tpu.utils.failures.TenantThrottledError`
+    (→ HTTP 429) when the tenant is shed, over quota, or rate-limited;
+    returns silently otherwise. No-op with the plane off."""
+    _chaos.site("tenancy.admit")
+    if not _ON:
+        return
+    tenant = str(tenant or "")
+    with _lock:
+        pol = _policies.get(tenant)
+        st = _state(tenant)
+        now = time.monotonic()
+        rank = 0 if st.depri_until > now else (
+            pol.rank if pol is not None else _DEFAULT_RANK
+        )
+        if _shed_active and rank <= PRIORITIES["batch"]:
+            verdict = ("shed", _SHED_RETRY_S)
+        elif pol is not None and (pol.max_active or pol.max_queued) and (
+            active + queued >= pol.max_active + pol.max_queued
+        ):
+            verdict = ("quota", 1.0)
+        else:
+            wait = st.req_bucket.try_take(1.0, now)
+            if wait <= 0.0:
+                wait = st.tok_bucket.try_take(float(new_tokens), now)
+            verdict = ("rate", wait) if wait > 0.0 else None
+        if verdict is None:
+            return
+        reason, retry_after = verdict
+        st.throttles[reason] = st.throttles.get(reason, 0) + 1
+    _m_throttled.inc(tenant=tenant or "-", reason=reason)
+    _flight.record(
+        "tenancy", "throttle", tenant=tenant, reason=reason,
+        retry_after_s=round(retry_after, 3),
+    )
+    raise TenantThrottledError(
+        f"tenant {tenant!r} throttled ({reason}); retry in "
+        f"{retry_after:.1f}s",
+        retry_after=retry_after, reason=reason, tenant=tenant,
+    )
+
+
+def count_preemption(rank: int) -> None:
+    """Book one serving preemption under the victim's priority class
+    (``serve.preemptions_total{priority}``). Counted whether or not
+    the plane is on — preemptions are rare and the class label is the
+    whole point of the series."""
+    _m_preemptions.inc(
+        priority=_RANK_NAMES.get(int(rank), str(int(rank)))
+    )
+
+
+def clamp_spec_k(
+    k: int, rank: int, pages_free: int, pages_total: int
+) -> int:
+    """Priority-weighted speculative depth: under KV-pool pressure
+    (less than a quarter of pages free) low-priority slots give up
+    their speculative page appetite first — batch drops to k=1,
+    standard to k=2, interactive keeps its adaptive k. Speculation
+    depth never changes emitted bytes (exact-match acceptance), only
+    how many pages a slot's burst may touch. Identity with the plane
+    off."""
+    if not _ON or k <= 1:
+        return k
+    if pages_total <= 0 or pages_free * 4 >= pages_total:
+        return k
+    if rank <= PRIORITIES["batch"]:
+        return 1
+    if rank == PRIORITIES["standard"]:
+        return min(k, 2)
+    return k
+
+
+def update_active_gauge(slots) -> None:
+    """Refresh ``serve.tenant_active_slots{tenant}`` from the
+    scheduler's live slot list (engine gauge sweep; plane-on only —
+    the caller gates). Tenants seen before but idle now are zeroed so
+    the gauge decays instead of pinning its last busy value."""
+    counts: Dict[str, int] = {}
+    for act in slots:
+        if act is not None:
+            key = act.req.tenant or "-"
+            counts[key] = counts.get(key, 0) + 1
+    with _lock:
+        known = {name or "-" for name in _states}
+    for name in known | set(counts):
+        _m_active_slots.set(float(counts.get(name, 0)), tenant=name)
+
+
+def register_fleet(fleet) -> None:
+    """Let the SLO actuator re-place a deprioritized tenant's sessions
+    (``fleet.replace_tenant_sessions``). Weakly referenced; passing
+    ``None`` (or the fleet dying) unregisters."""
+    global _fleet_ref
+    _fleet_ref = None if fleet is None else weakref.ref(fleet)
+
+
+def _top_cost_tenant() -> Optional[str]:
+    """The most expensive tenant over the recent cost-ledger window
+    (sum of est_flops, tokens as tie-break) — the sustained-burn
+    deprioritization target. None when the ledger is empty or every
+    row is tenant-less."""
+    from ..obs import requests as _obs_requests
+
+    flops: Dict[str, float] = {}
+    tokens: Dict[str, int] = {}
+    for row in _obs_requests.recent():
+        tenant = str(row.get("tenant") or "")
+        if not tenant:
+            continue
+        flops[tenant] = flops.get(tenant, 0.0) + float(
+            row.get("est_flops") or 0.0
+        )
+        tokens[tenant] = tokens.get(tenant, 0) + int(row.get("tokens") or 0)
+    if not flops:
+        return None
+    return max(flops, key=lambda t: (flops[t], tokens.get(t, 0), t))
+
+
+def _act(action: str, **fields) -> None:
+    _m_actions.inc(action=action)
+    _flight.record("tenancy", action, **fields)
+
+
+def slo_tick(now: Optional[float] = None) -> None:
+    """The SLO actuator, riding every sampler tick immediately after
+    ``slo.monitor().evaluate`` (obs/timeseries.sample_once). Reads the
+    burn state and *acts*:
+
+    - any objective breached, shedding off → turn shedding ON
+      (``batch``-class admissions 429 until recovery);
+    - a *sustained* burn (slow window burning too) → deprioritize the
+      top-cost tenant for :data:`_DEPRI_HOLD_S` seconds (rate-limited
+      to one action per hold) and ask the registered fleet to re-place
+      that tenant's pinned sessions onto the least-loaded replica;
+    - nothing breached, shedding on → recover (re-admit).
+
+    One boolean check with the plane off. ``now`` is accepted for
+    signature symmetry with the other sampler duties; holds use the
+    monotonic clock."""
+    global _shed_active, _next_depri_t
+    if not _ON:
+        return
+    from ..obs import slo as _slo
+
+    rows = _slo.monitor().status()
+    breached = [r for r in rows if r.get("breached")]
+    mono = time.monotonic()
+    if breached and not _shed_active:
+        _shed_active = True
+        _act(
+            "shed_batch",
+            slos=[r.get("name") for r in breached],
+        )
+    elif not breached and _shed_active:
+        _shed_active = False
+        _act("recover")
+    sustained = [
+        r for r in breached if r.get("severity") == "sustained"
+    ]
+    if sustained and mono >= _next_depri_t:
+        tenant = _top_cost_tenant()
+        if tenant is not None:
+            _next_depri_t = mono + _DEPRI_HOLD_S
+            with _lock:
+                _state(tenant).depri_until = mono + _DEPRI_HOLD_S
+            _act(
+                "deprioritize", tenant=tenant,
+                hold_s=_DEPRI_HOLD_S,
+                slos=[r.get("name") for r in sustained],
+            )
+            fleet = _fleet_ref() if _fleet_ref is not None else None
+            if fleet is not None:
+                try:
+                    moved = fleet.replace_tenant_sessions(tenant)
+                except Exception:
+                    logger.warning(
+                        "session re-placement for tenant %r failed",
+                        tenant, exc_info=True,
+                    )
+                else:
+                    if moved:
+                        _act(
+                            "replace_sessions", tenant=tenant,
+                            sessions=moved,
+                        )
+
+
+def policies_view() -> List[Dict[str, Any]]:
+    """The live policy registry as JSON-ready dicts (``GET
+    /admin/tenants``)."""
+    with _lock:
+        return [
+            _policies[name].as_dict() for name in sorted(_policies)
+        ]
+
+
+def apply_admin(payload: Any) -> List[Dict[str, Any]]:
+    """Apply a ``POST /admin/tenants`` body and return the resulting
+    registry view. Three shapes:
+
+    - a single policy object → upsert that tenant;
+    - ``{"tenant": NAME, "delete": true}`` → remove it;
+    - ``{"tenants": [...]}`` → replace the whole registry (``[]``
+      turns the plane off).
+
+    Validation errors raise ``ValueError`` (→ HTTP 400) before any
+    state changes; the accepted set lands via ``set_config`` so every
+    ``register_on_change`` consumer sees it atomically."""
+    if not isinstance(payload, dict):
+        raise ValueError("body must be a JSON object")
+    if "tenants" in payload:
+        new = [ _parse_policy(s).as_dict() for s in payload["tenants"] ]
+    elif payload.get("delete"):
+        name = str(payload.get("tenant") or "")
+        if not name:
+            raise ValueError("delete needs a 'tenant' name")
+        new = [p for p in policies_view() if p["tenant"] != name]
+    else:
+        pol = _parse_policy(
+            {k: v for k, v in payload.items() if k != "delete"}
+        )
+        new = [
+            p for p in policies_view() if p["tenant"] != pol.tenant
+        ] + [pol.as_dict()]
+    set_config(tenants=tuple(new))
+    return policies_view()
+
+
+def _ledger_fold() -> Dict[str, Dict[str, Any]]:
+    """Per-tenant aggregation of the recent cost-ledger ring —
+    read-side only, no new bookkeeping."""
+    from ..obs import requests as _obs_requests
+
+    out: Dict[str, Dict[str, Any]] = {}
+    rows = _obs_requests.recent()
+    for row in rows:
+        tenant = str(row.get("tenant") or "") or "-"
+        agg = out.setdefault(
+            tenant,
+            {
+                "requests": 0, "tokens": 0, "est_flops": 0.0,
+                "ttft_s": [], "_ts": [],
+            },
+        )
+        agg["requests"] += 1
+        agg["tokens"] += int(row.get("tokens") or 0)
+        agg["est_flops"] += float(row.get("est_flops") or 0.0)
+        ttft = (
+            float(row.get("queue_wait_s") or 0.0)
+            + float(row.get("prefill_s") or 0.0)
+        )
+        if ttft > 0:
+            agg["ttft_s"].append(ttft)
+        try:
+            agg["_ts"].append(float(row["ts"]))
+        except (KeyError, TypeError, ValueError):
+            pass
+    for agg in out.values():
+        ts = agg.pop("_ts")
+        span = (max(ts) - min(ts)) if len(ts) >= 2 else 0.0
+        agg["tokens_per_s"] = (
+            round(agg["tokens"] / span, 3) if span > 0 else None
+        )
+        ttfts = sorted(agg.pop("ttft_s"))
+        agg["ttft_p99_s"] = (
+            round(ttfts[min(len(ttfts) - 1,
+                            int(0.99 * len(ttfts)))], 6)
+            if ttfts else None
+        )
+    return out
+
+
+def statusz_view(engine=None) -> Optional[Dict[str, Any]]:
+    """The ``/statusz`` per-tenant block: policies, live footprint
+    (active slots + queue share from the scheduler, duck-typed through
+    an engine or fleet), recent ledger throughput/cost, throttle and
+    actuator state. None with the plane off (the page stays byte-
+    identical to pre-tenancy)."""
+    if not _ON:
+        return None
+    active: Dict[str, int] = {}
+    queued: Dict[str, int] = {}
+    counts_fn = getattr(engine, "tenant_counts", None)
+    if counts_fn is None:
+        sched = getattr(engine, "scheduler", None)
+        counts_fn = getattr(sched, "tenant_counts", None)
+    if counts_fn is not None:
+        try:
+            active, queued = counts_fn()
+        except Exception:  # pragma: no cover - defensive
+            active, queued = {}, {}
+    ledger = _ledger_fold()
+    mono = time.monotonic()
+    with _lock:
+        names = sorted(
+            set(_policies) | set(_states) | set(active) | set(queued)
+            | {n for n in ledger if n != "-"}
+        )
+        tenants = []
+        for name in names:
+            pol = _policies.get(name)
+            st = _states.get(name)
+            row: Dict[str, Any] = {
+                "tenant": name,
+                "priority": pol.priority if pol else "standard",
+                "active_slots": int(active.get(name, 0)),
+                "queued": int(queued.get(name, 0)),
+                "throttles": dict(st.throttles) if st else {},
+                "deprioritized": bool(
+                    st and st.depri_until > mono
+                ),
+            }
+            if pol is not None:
+                row["policy"] = pol.as_dict()
+            row.update(
+                ledger.get(
+                    name,
+                    {"requests": 0, "tokens": 0, "est_flops": 0.0,
+                     "tokens_per_s": None, "ttft_p99_s": None},
+                )
+            )
+            if pol is not None and pol.ttft_slo_s > 0:
+                p99 = row.get("ttft_p99_s")
+                row["ttft_slo_s"] = pol.ttft_slo_s
+                row["ttft_slo_ok"] = (
+                    None if p99 is None else p99 <= pol.ttft_slo_s
+                )
+            tenants.append(row)
+    return {"shedding": _shed_active, "tenants": tenants}
+
+
+def _reset_for_tests() -> None:
+    """Drop all runtime state (buckets, holds, shedding, fleet ref) —
+    test isolation. Policies still come from the live config."""
+    global _shed_active, _next_depri_t, _fleet_ref
+    with _lock:
+        _states.clear()
+        _shed_active = False
+        _next_depri_t = 0.0
+    _fleet_ref = None
+    _refresh()

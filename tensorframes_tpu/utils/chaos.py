@@ -144,6 +144,7 @@ SITES = (
     "fleet.place",
     "fleet.replica_fault",
     "tune.trial",
+    "tenancy.admit",
 )
 
 #: sites whose code COMPOSES dotted suffixes at runtime (their FAMILY):

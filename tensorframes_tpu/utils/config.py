@@ -222,6 +222,19 @@ class Config:
     #: flagged, never dropped, so a kill -9'd worker's last counters
     #: stay visible (docs/observability.md "Fleet telemetry").
     telemetry_stale_after_s: float = 15.0
+    #: per-tenant QoS policies (``serve/tenancy.py``): a tuple of plain
+    #: dicts, one per tenant, each shaped like ``{"tenant": "acme",
+    #: "priority": "batch"|"standard"|"interactive", "max_active": N,
+    #: "max_queued": N, "requests_per_s": R, "tokens_per_s": T,
+    #: "ttft_slo_s": S}`` — every field but ``tenant`` optional, 0/absent
+    #: = unlimited/none. The EMPTY default means the whole QoS plane is
+    #: off: no admission checks, FIFO scheduling, preempt-youngest —
+    #: byte-identical to the pre-tenancy engine at zero per-step cost
+    #: (the on/off gate is a module global refreshed by the set_config
+    #: callback hook, the TFT_OBS/chaos pattern). Also settable at
+    #: runtime via ``POST /admin/tenants``. See docs/serving_llm.md
+    #: "Multi-tenancy".
+    tenants: tuple = ()
 
 
 _lock = threading.Lock()

@@ -54,6 +54,7 @@ __all__ = [
     "PagePoolExhausted",
     "QuarantinedBlocksError",
     "StaleLeaseError",
+    "TenantThrottledError",
 ]
 
 logger = get_logger("failures")
@@ -208,6 +209,31 @@ class StaleLeaseError(RuntimeError):
     to retry the fenced write."""
 
 
+class TenantThrottledError(RuntimeError):
+    """A generation request was refused by the multi-tenant QoS plane
+    (:mod:`tensorframes_tpu.serve.tenancy`): the tenant is over its
+    admission quota, its token-bucket rate limit is empty, or an SLO
+    shed is active for its priority class. A per-*tenant* condition,
+    not a per-*server* one — the engine has capacity, this tenant may
+    not use it right now — so HTTP maps it to ``429 Too Many
+    Requests`` with a ``Retry-After`` derived from ``retry_after``
+    (the bucket's refill time), distinct from the all-full 503.
+    Deliberately terminal: never retried by ``run_with_retries`` and
+    never replayed by the fleet router (a replay would re-charge the
+    tenant's budget for work it was refused)."""
+
+    def __init__(
+        self, message: str, *, retry_after: float = 1.0,
+        reason: str = "quota", tenant: str = "",
+    ):
+        super().__init__(message)
+        #: seconds until the refusing limiter expects to admit again
+        self.retry_after = float(retry_after)
+        #: which gate refused: ``"quota"`` | ``"rate"`` | ``"shed"``
+        self.reason = str(reason)
+        self.tenant = str(tenant)
+
+
 class DeadlineExceededError(TimeoutError):
     """A generation request outlived its caller-supplied deadline and was
     evicted by the serving scheduler (queued or mid-generation). A
@@ -229,7 +255,10 @@ def is_transient(e: BaseException) -> bool:
     # chain: a StaleLeaseError raised `from` an UNAVAILABLE cause must
     # not inherit that cause's retryability — the lease is gone
     if any(
-        isinstance(x, (DeadlineExceededError, StaleLeaseError))
+        isinstance(
+            x,
+            (DeadlineExceededError, StaleLeaseError, TenantThrottledError),
+        )
         for x in _exc_chain(e)
     ) or is_oom(e):
         return False
