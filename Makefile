@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-fleet test-multihost test-obs test-obsfleet test-plan test-spec test-tenancy test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-elastic test-fleet test-multihost test-obs test-obsfleet test-plan test-spec test-tenancy test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -117,6 +117,15 @@ test-tenancy:
 # e.g. under a bare `python -m pytest tests/test_serve_tp.py::...`
 test-tp:
 	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" $(PY) -m pytest tests/ -q -m tp
+
+# the elastic multi-host fleet suite (serve/membership.py: lease-based
+# membership + epoch fencing, remote replicas over HTTP with failover
+# byte-identity, /readyz + SIGTERM drain, rolling restart / hot weight
+# swap with probe-gated re-admission) — the fast tests are tier-1; the
+# 3-subprocess kill -9 + wedge acceptance soak is marked slow and runs
+# here too
+test-elastic:
+	$(PY) -m pytest tests/ -q -m elastic
 
 # just the real 2-process distributed suite
 test-multihost:

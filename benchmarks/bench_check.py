@@ -15,7 +15,17 @@ it.
 Tolerance is deliberately generous (default 30%): these are wall-clock
 benches on shared hosts, and the gate exists to catch *structural*
 regressions (a lost fast path, an accidental sync, a double upload),
-not scheduler noise. Override per-run with ``TFT_BENCH_TOLERANCE_PCT``.
+not scheduler noise. Precedence, loosest binding last:
+
+1. ``TFT_BENCH_TOLERANCE_PCT`` (env — a one-run operator override for
+   EVERY metric);
+2. ``bench_gate.tolerances[<metric>]`` (per-metric override recorded
+   in BASELINE.json — for metrics with measured machine-to-machine
+   variance wider than the global band, e.g. ``map_rows`` throughput,
+   which swings with filesystem cache state far more than the
+   decode-bound serve bench); preserved across ``--update``;
+3. ``bench_gate.tolerance_pct`` (the recorded global band);
+4. the built-in 30% default.
 
 Usage::
 
@@ -122,8 +132,22 @@ def _load_baseline() -> dict:
         return json.load(f)
 
 
+def _tolerance_for(metric: str, gate: dict) -> float:
+    """Resolve one metric's tolerance band (percent below baseline
+    that still passes): env override > per-metric ``tolerances`` entry
+    > global ``tolerance_pct`` > default."""
+    env_tol = os.environ.get("TFT_BENCH_TOLERANCE_PCT", "")
+    if env_tol:
+        return float(env_tol)
+    per_metric = gate.get("tolerances") or {}
+    if metric in per_metric:
+        return float(per_metric[metric])
+    return float(gate.get("tolerance_pct", DEFAULT_TOLERANCE_PCT))
+
+
 def update() -> int:
     base = _load_baseline()
+    prior = base.get("bench_gate") or {}
     gate = {
         "comment": (
             "perf-regression gate for `make bench-check`: headline bench "
@@ -135,6 +159,10 @@ def update() -> int:
         "env": {k: v for k, v in GATE_ENV.items() if k != "JAX_PLATFORMS"},
         "metrics": {},
     }
+    # per-metric bands survive a re-record: they encode each metric's
+    # MEASURED variance on this class of host, not the baseline values
+    if prior.get("tolerances"):
+        gate["tolerances"] = dict(prior["tolerances"])
     for config, metric in CONFIGS:
         print(f"[bench-check] measuring {config} ...", flush=True)
         result = _run_bench(config, GATE_ENV)
@@ -166,15 +194,12 @@ def check() -> int:
             "one with `python benchmarks/bench_check.py --update`\n"
         )
         return 2
-    tol = float(
-        os.environ.get("TFT_BENCH_TOLERANCE_PCT", "")
-        or gate.get("tolerance_pct", DEFAULT_TOLERANCE_PCT)
-    )
     env = dict(GATE_ENV)
     env.update(gate.get("env", {}))
     failures = []
     for metric, entry in gate["metrics"].items():
         config = entry["config"]
+        tol = _tolerance_for(metric, gate)
         print(f"[bench-check] running {config} ...", flush=True)
         result = _run_bench(config, env)
         fresh, baseline = float(result["value"]), float(entry["value"])

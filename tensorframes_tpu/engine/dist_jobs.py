@@ -68,13 +68,20 @@ Chaos sites: ``jobs.lease`` (claim/reclaim path) and
 ``jobs.heartbeat`` (renewal — ``latency`` past the TTL is the
 presumed-dead drill). See docs/fault_tolerance.md for the cookbook and
 the multi-process kill soak in ``tests/test_dist_jobs.py``.
+
+The lease *mechanics* (epoch-stamped files, atomic claim, heartbeats,
+ownership re-validation) are the reusable primitive
+:class:`~tensorframes_tpu.utils.leases.LeaseStore` — the serving
+fleet's member registry (:mod:`tensorframes_tpu.serve.membership`)
+runs on the same machinery. This module keeps the *job policy*:
+block/journal keys, the guard/worker handshake, ``jobs.*`` metrics,
+chaos sites, and the journal-writer write fence.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
-import json
 import os
 import socket
 import threading
@@ -96,6 +103,7 @@ from ..utils.failures import (
     retry_deadline,
     run_with_retries,
 )
+from ..utils.leases import LeaseStore, LeaseView
 from .jobs import (
     _BLOCK_DIR,
     _OPS,
@@ -109,6 +117,7 @@ from .jobs import (
 
 __all__ = [
     "LeaseManager",
+    "LeaseView",
     "WorkerReport",
     "journal_guard",
     "journal_status",
@@ -148,36 +157,22 @@ _g_worker_blocks = _gauge(
 )
 
 
-@dataclasses.dataclass
-class LeaseView:
-    """Parsed view of one lease key's CURRENT (highest-epoch) file."""
-
-    key: str
-    epoch: int
-    worker: str
-    deadline_unix: float
-    state: str  # "live" (held or expired — check the deadline) | "done"
-    fname: str
-
-    @property
-    def expired(self) -> bool:
-        return self.state != "done" and self.deadline_unix <= time.time()
-
-
 def _block_key(block: Optional[int]) -> str:
     return _JOURNAL_KEY if block is None else f"block-{block:05d}"
 
 
-class LeaseManager:
+class LeaseManager(LeaseStore):
     """Filesystem lease table for one journal directory.
 
-    Epoch-in-the-filename is the whole trick: creating
-    ``<key>.e{epoch:06d}.lease`` is atomic create-if-absent (hard link
-    of a fully written temp file), so claiming any (key, epoch) pair
-    has exactly one winner, reclamation is an exclusive race for
-    ``epoch + 1``, and the epoch doubles as the monotonic **fencing
-    token** stamped into every journal record. The current lease for a
-    key is simply its highest-epoch file."""
+    The mechanics — epoch-stamped ``<key>.e{epoch:06d}.lease`` files,
+    atomic exclusive claims, heartbeat renewal with ownership
+    re-validation — are inherited from
+    :class:`~tensorframes_tpu.utils.leases.LeaseStore`; see its
+    docstring for why epoch-in-the-filename makes every (key, epoch)
+    claim single-winner with no lock server. This subclass adds the
+    *job* policy: block vs journal keys, the resume-guard handshake,
+    ``jobs.*`` metrics + chaos sites, and the journal writer's
+    :meth:`fence_check`."""
 
     def __init__(
         self,
@@ -187,90 +182,13 @@ class LeaseManager:
         heartbeat_s: float = 0.0,
         create: bool = True,
     ):
-        if ttl_s <= 0:
-            raise ValueError(f"lease ttl must be > 0; got {ttl_s}")
-        self.root = path
-        self.dir = os.path.join(path, _LEASE_DIR)
-        if create:
-            os.makedirs(self.dir, exist_ok=True)
-        self.worker_id = worker_id
-        self.ttl_s = float(ttl_s)
-        self.heartbeat_s = float(heartbeat_s) or self.ttl_s / 3.0
-        self._lock = threading.Lock()
-        #: key -> (epoch, fname) for leases this manager holds live
-        self._held: Dict[str, Tuple[int, str]] = {}
-        self._stop = threading.Event()
-        self._hb: Optional[threading.Thread] = None
+        super().__init__(
+            path, worker_id, ttl_s, heartbeat_s=heartbeat_s, create=create
+        )
         self.claimed_total = 0
         self.reclaimed_total = 0
 
     # -- scanning ----------------------------------------------------------
-
-    def _scan(self, key: str) -> Optional[LeaseView]:
-        """The key's current lease: its highest-epoch file, parsed. An
-        unreadable file (a crash artifact — every write here is a
-        link/rename of complete content, so this should not happen)
-        reads as an expired live lease, i.e. reclaimable."""
-        try:
-            names = os.listdir(self.dir)
-        except FileNotFoundError:
-            return None
-        prefix = key + ".e"
-        best: Optional[Tuple[int, str]] = None
-        for n in names:
-            if not (n.startswith(prefix) and n.endswith(".lease")):
-                continue
-            try:
-                epoch = int(n[len(prefix):-len(".lease")])
-            except ValueError:
-                continue
-            if best is None or epoch > best[0]:
-                best = (epoch, n)
-        if best is None:
-            return None
-        return self._read_view(key, best[0], best[1])
-
-    def _read_view(self, key: str, epoch: int, fname: str) -> LeaseView:
-        try:
-            with open(os.path.join(self.dir, fname), "r") as f:
-                d = json.load(f)
-        except (OSError, ValueError):
-            d = {}
-        return LeaseView(
-            key=key,
-            epoch=epoch,
-            worker=str(d.get("worker", "")),
-            deadline_unix=float(d.get("deadline_unix", 0.0)),
-            state=str(d.get("state", "live")),
-            fname=fname,
-        )
-
-    def scan_all(self) -> List[LeaseView]:
-        """Current lease view of every key: ONE directory listing,
-        grouped by key with the max epoch kept, then one file read per
-        key — not a per-key re-listing (O(keys²) on big journals)."""
-        try:
-            names = os.listdir(self.dir)
-        except FileNotFoundError:
-            return []
-        best: Dict[str, Tuple[int, str]] = {}
-        for n in names:
-            if not n.endswith(".lease"):
-                continue
-            key, sep, rest = n[: -len(".lease")].rpartition(".e")
-            if not sep:
-                continue
-            try:
-                epoch = int(rest)
-            except ValueError:
-                continue
-            cur = best.get(key)
-            if cur is None or epoch > cur[0]:
-                best[key] = (epoch, n)
-        return [
-            self._read_view(key, epoch, fname)
-            for key, (epoch, fname) in sorted(best.items())
-        ]
 
     def live_block_leases(self) -> List[LeaseView]:
         """Live (unexpired, not done, not ours) block leases — the
@@ -297,39 +215,6 @@ class LeaseManager:
         )
 
     # -- claiming ----------------------------------------------------------
-
-    def _payload(self, epoch: int, state: str = "live") -> bytes:
-        return json.dumps(
-            {
-                "worker": self.worker_id,
-                "epoch": epoch,
-                "state": state,
-                "deadline_unix": time.time() + self.ttl_s,
-                "written_unix": time.time(),
-            }
-        ).encode("utf-8")
-
-    def _create_excl(self, fname: str, payload: bytes) -> bool:
-        """Atomically create ``fname`` with ``payload`` iff absent:
-        write a private temp file completely, then hard-link it to the
-        target — EEXIST means another worker won the epoch."""
-        target = os.path.join(self.dir, fname)
-        tmp = os.path.join(
-            self.dir, f".tmp-{self.worker_id}-{uuid.uuid4().hex[:8]}"
-        )
-        with open(tmp, "wb") as f:
-            f.write(payload)
-            f.flush()
-        try:
-            os.link(tmp, target)
-            return True
-        except FileExistsError:
-            return False
-        finally:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
 
     def try_acquire(self, block: Optional[int]) -> Optional[int]:
         """Claim (or reclaim) one block's lease; ``None`` is the
@@ -410,15 +295,7 @@ class LeaseManager:
                     now - cur.deadline_unix,
                 )
                 # housekeeping: the superseded epoch files are dead weight
-                for old in range(cur.epoch + 1):
-                    try:
-                        os.unlink(
-                            os.path.join(
-                                self.dir, f"{key}.e{old:06d}.lease"
-                            )
-                        )
-                    except OSError:
-                        pass
+                self._unlink_superseded(key, epoch)
             elif key != _JOURNAL_KEY:
                 _m_claims.inc()
                 self.claimed_total += 1
@@ -429,74 +306,22 @@ class LeaseManager:
 
     # -- renewal / release -------------------------------------------------
 
-    def _rewrite(self, fname: str, payload: bytes) -> None:
-        target = os.path.join(self.dir, fname)
-        tmp = target + f".w-{uuid.uuid4().hex[:8]}"
-        with open(tmp, "wb") as f:
-            f.write(payload)
-            f.flush()
-        os.replace(tmp, target)
-
-    def renew_all(self) -> None:
-        """One heartbeat sweep: rewrite every held lease with a fresh
-        deadline. The chaos site ``jobs.heartbeat`` sits inside — a
-        ``latency`` injection longer than the TTL is the presumed-dead
-        drill (the sweep stalls, the lease expires, the block is
-        reclaimed, and this worker's late write is fence-rejected)."""
+    def renew_all(self) -> int:
+        """One heartbeat sweep (ownership-re-validating; inherited).
+        The chaos site ``jobs.heartbeat`` sits inside — a ``latency``
+        injection longer than the TTL is the presumed-dead drill (the
+        sweep stalls, the lease expires, the block is reclaimed, and
+        this worker's late write is fence-rejected)."""
         from ..utils import chaos as _chaos
 
         _chaos.site("jobs.heartbeat")
-        for key, (epoch, fname) in list(self._held.items()):
-            # re-validate ownership BEFORE rewriting: _rewrite is an
-            # os.replace, which would re-CREATE a superseded file the
-            # reclaimer's housekeeping already unlinked — a phantom
-            # stale lease this worker would then renew forever
-            cur = self._scan(key)
-            if (
-                cur is None
-                or cur.epoch != epoch
-                or cur.worker != self.worker_id
-            ):
-                self._drop_held(key, epoch, fname)
-                continue
-            with self._lock:
-                if self._held.get(key) != (epoch, fname):
-                    continue  # recorded/released between snapshot and now
-                self._rewrite(fname, self._payload(epoch))
+        renewed = super().renew_all()
+        for _ in range(renewed):
             _m_heartbeats.inc()
+        return renewed
 
-    def _drop_held(self, key: str, epoch: int, fname: str) -> None:
-        """Forget a lease we no longer own and unlink our (now
-        superseded) epoch file if it still exists — never the current
-        one, which has a different epoch in its name."""
-        with self._lock:
-            if self._held.get(key) == (epoch, fname):
-                self._held.pop(key, None)
-        try:
-            os.unlink(os.path.join(self.dir, fname))
-        except OSError:
-            pass
-
-    def _hb_loop(self) -> None:
-        while not self._stop.wait(self.heartbeat_s):
-            try:
-                self.renew_all()
-            except Exception:
-                # a failed sweep is survivable until the TTL runs out;
-                # the next tick retries. Never kill the thread.
-                logger.warning(
-                    "worker %s: lease heartbeat sweep failed",
-                    self.worker_id, exc_info=True,
-                )
-
-    def _ensure_heartbeat(self) -> None:
-        if self._hb is None or not self._hb.is_alive():
-            self._hb = threading.Thread(
-                target=self._hb_loop,
-                name=f"tft-lease-hb-{self.worker_id}",
-                daemon=True,
-            )
-            self._hb.start()
+    def _heartbeat_sweep(self) -> None:
+        self.renew_all()
 
     def mark_done(self, block: int, epoch: int) -> None:
         """Terminal marker: the block's record landed; rewrite the lease
@@ -560,18 +385,7 @@ class LeaseManager:
     def stop(self, unlink_held: bool = True) -> None:
         """Stop heartbeats and (by default) release everything held so
         other workers need not wait out the TTL."""
-        self._stop.set()
-        if self._hb is not None:
-            self._hb.join(timeout=self.heartbeat_s + 5.0)
-        if unlink_held:
-            for key in list(self._held):
-                with self._lock:
-                    held = self._held.pop(key, None)
-                if held is not None:
-                    try:
-                        os.unlink(os.path.join(self.dir, held[1]))
-                    except OSError:
-                        pass
+        super().stop(unlink_held=unlink_held)
         _g_leases_held.set(0, worker=self.worker_id)
 
 

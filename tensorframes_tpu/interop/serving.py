@@ -181,6 +181,8 @@ class ScoringServer:
         port: int = 0,
         max_connections: int = 8,
         engine=None,
+        readiness=None,
+        lifecycle=None,
     ):
         if fetches is None and engine is None:
             raise ValueError(
@@ -207,6 +209,17 @@ class ScoringServer:
         #: ``POST /generate`` (tensorframes_tpu.serve.GenerationEngine)
         self._engine = engine
         self._engine_started_here = False
+        #: readiness probe for ``GET /readyz``: ``() -> (ready, state)``
+        #: — a serving member (serve/membership.py) reports not-ready
+        #: while draining / probing / mid-weight-swap so rollouts can
+        #: gate traffic WITHOUT touching /healthz's liveness meaning.
+        #: ``None`` → readiness mirrors liveness.
+        self._readiness = readiness
+        #: lifecycle actuator for ``POST /admin/lifecycle``:
+        #: ``(action, spec) -> payload dict`` (drain / admit / restart /
+        #: swap / rollback — serve/membership.py wires the member's
+        #: state machine in). ``None`` → the endpoint answers 501.
+        self._lifecycle = lifecycle
         self._host = host
         self._requested_port = port  # 0 = ephemeral, fresh per start()
         self._port = port
@@ -332,10 +345,12 @@ class ScoringServer:
     _ROUTES: Dict[str, Tuple[str, ...]] = {
         "/metrics": ("GET",),
         "/healthz": ("GET",),
+        "/readyz": ("GET",),
         "/statusz": ("GET",),
         "/varz": ("GET",),
         "/generate": ("POST",),
         "/admin/tenants": ("GET", "POST"),
+        "/admin/lifecycle": ("POST",),
     }
 
     @classmethod
@@ -378,6 +393,11 @@ class ScoringServer:
           ``status`` field says ``"degraded"`` under an SLO breach),
           503 once the serving supervisor marked the engine unhealthy
           or a stop wedged;
+        - ``GET /readyz`` — readiness JSON (``{"ready", "state"}``):
+          503 while a fleet member is draining / probing /
+          mid-weight-swap even though it is perfectly alive — the
+          traffic gate rollouts and balancers act on (liveness and
+          readiness are deliberately separate probes);
         - ``GET /varz`` — the time-series store as JSON (sampled
           gauges, counter rates, histogram quantiles; ``prefix=`` /
           ``window=`` query params);
@@ -393,7 +413,15 @@ class ScoringServer:
           QoS policy refuses it (quota / rate / SLO shed);
         - ``GET|POST /admin/tenants`` — the QoS policy registry
           (``serve/tenancy.py``): read or update per-tenant quotas,
-          rate limits, and priority classes at runtime.
+          rate limits, and priority classes at runtime;
+        - ``POST /admin/lifecycle`` — the fleet-member lifecycle
+          actuator (drain / admit / restart / swap / rollback /
+          commit; ``serve/membership.py``).
+
+        ``POST /generate`` with ``"stream": true`` answers NDJSON: one
+        ``{"t": token}`` line per emission and a terminal ``{"done":
+        ...}`` / ``{"error": ..., "kind": ...}`` line — the wire the
+        fleet router's remote replicas relay token-by-token.
 
         Unknown paths answer 404; known paths with the wrong verb 405
         with an ``Allow`` header. Returns the request kind for the
@@ -438,8 +466,9 @@ class ScoringServer:
             # an unknown path is the CLIENT's mistake: say so crisply
             # instead of falling through to an ambiguous catch-all
             out = (
-                b"endpoints: GET /metrics, GET /healthz, GET /statusz, "
-                b"GET /varz, POST /generate, GET|POST /admin/tenants\n"
+                b"endpoints: GET /metrics, GET /healthz, GET /readyz, "
+                b"GET /statusz, GET /varz, POST /generate, "
+                b"GET|POST /admin/tenants, POST /admin/lifecycle\n"
             )
             status = "404 Not Found"
         elif verb not in allowed:
@@ -458,6 +487,10 @@ class ScoringServer:
             kind = "healthz"
             status, out, extra_headers = self._handle_healthz()
             ctype = "application/json; charset=utf-8"
+        elif norm == "/readyz":
+            kind = "readyz"
+            status, out, extra_headers = self._handle_readyz()
+            ctype = "application/json; charset=utf-8"
         elif norm == "/statusz":
             kind = "statusz"
             status, out, extra_headers = self._handle_statusz()
@@ -472,11 +505,16 @@ class ScoringServer:
                 verb, body
             )
             ctype = "application/json; charset=utf-8"
+        elif norm == "/admin/lifecycle":
+            kind = "lifecycle"
+            status, out, extra_headers = self._handle_lifecycle(body)
+            ctype = "application/json; charset=utf-8"
         else:  # /generate, POST
             kind = "generate"
-            status, out, extra_headers = self._handle_generate(
-                body, headers
-            )
+            res = self._handle_generate(body, headers, conn=conn)
+            if res is None:
+                return kind  # streamed: the response is already on the wire
+            status, out, extra_headers = res
             ctype = "application/json; charset=utf-8"
         header_lines = "".join(
             f"{k}: {v}\r\n" for k, v in extra_headers.items()
@@ -575,6 +613,87 @@ class ScoringServer:
         return "503 Service Unavailable", body, {
             "Retry-After": _adaptive_retry_after(self._engine)
         }
+
+    def _handle_readyz(self) -> Tuple[str, bytes, Dict[str, str]]:
+        """``GET /readyz`` — readiness, as distinct from ``/healthz``'s
+        liveness: "should a balancer SEND this member traffic right
+        now", not "is the process worth keeping alive". A serving
+        member answers 503 while **draining** (rolling restart /
+        SIGTERM), **probing** (restarted, not yet re-validated), or
+        **mid-weight-swap** — states where the process is perfectly
+        healthy (``/healthz`` stays 200/ok, a balancer must NOT recycle
+        it) but must not take new streams. Without a readiness hook
+        (plain scorer / standalone engine server) readiness mirrors
+        liveness, so probing either endpoint is always safe."""
+        import json
+
+        state = "ready"
+        if self._readiness is not None:
+            try:
+                ready, state = self._readiness()
+            except Exception as e:  # a probe must never 500
+                ready, state = False, f"error: {type(e).__name__}"
+        elif self._engine is not None:
+            ready = bool(self._engine.health().get("healthy"))
+            state = "ready" if ready else "unhealthy"
+        else:
+            ready = True
+        body = json.dumps({"ready": bool(ready), "state": state}).encode(
+            "utf-8"
+        )
+        if ready:
+            return "200 OK", body, {}
+        return "503 Service Unavailable", body, {"Retry-After": "1"}
+
+    def _handle_lifecycle(
+        self, body: bytes
+    ) -> Tuple[str, bytes, Dict[str, str]]:
+        """``POST /admin/lifecycle`` — the member's lifecycle actuator
+        (``serve/membership.py`` wires it): ``{"action": "drain" |
+        "admit" | "restart" | "swap" | "rollback", ...}``. The rollout
+        orchestrator drives members through drain → restart/swap →
+        probe → admit over this endpoint; ``/readyz`` reflects each
+        transition. 501 when no lifecycle hook is configured, 400 for
+        an unknown action or bad spec, 500 when the action itself
+        failed (e.g. a checkpoint that does not load)."""
+        import json
+
+        if self._lifecycle is None:
+            return (
+                "501 Not Implemented",
+                json.dumps(
+                    {"error": "server has no lifecycle hook (not a "
+                              "fleet member)"}
+                ).encode("utf-8"),
+                {},
+            )
+        try:
+            spec = json.loads(body.decode("utf-8") or "{}")
+            action = str(spec.get("action", ""))
+        except ValueError as e:
+            return (
+                "400 Bad Request",
+                json.dumps({"error": f"bad JSON: {e}"}).encode("utf-8"),
+                {},
+            )
+        try:
+            payload = self._lifecycle(action, spec)
+        except ValueError as e:
+            return (
+                "400 Bad Request",
+                json.dumps({"error": str(e)}).encode("utf-8"),
+                {},
+            )
+        except Exception as e:
+            return (
+                "500 Internal Server Error",
+                json.dumps(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "kind": type(e).__name__}
+                ).encode("utf-8"),
+                {},
+            )
+        return "200 OK", json.dumps(dict(payload or {})).encode("utf-8"), {}
 
     def _handle_statusz(self) -> Tuple[str, bytes, Dict[str, str]]:
         """``GET /statusz`` — the operator's at-a-glance page, JSON:
@@ -881,8 +1000,11 @@ class ScoringServer:
         return out
 
     def _handle_generate(
-        self, body: bytes, headers: Optional[Dict[str, str]] = None
-    ) -> Tuple[str, bytes, Dict[str, str]]:
+        self,
+        body: bytes,
+        headers: Optional[Dict[str, str]] = None,
+        conn: Optional[socket.socket] = None,
+    ) -> Optional[Tuple[str, bytes, Dict[str, str]]]:
         """One generate request against the engine; returns (status,
         JSON body, extra headers). Failure modes map to HTTP semantics
         instead of crashing the connection thread: bad JSON / infeasible
@@ -898,7 +1020,24 @@ class ScoringServer:
         completed generations add a ``"timing"`` breakdown (queue wait,
         prefill, chunked-prefill count, decode, replay count), so a
         caller can join its own telemetry to the engine's spans in the
-        JSONL sink (docs/observability.md)."""
+        JSONL sink (docs/observability.md).
+
+        **Streaming**: ``"stream": true`` switches the success path to
+        NDJSON over the same connection — one ``{"t": token}`` line per
+        emission, then a terminal ``{"done": ...}`` or ``{"error": ...,
+        "kind": ExceptionName}`` line (returns ``None``: the response
+        is already on the wire). Pre-submit failures still answer their
+        plain-JSON status codes, each now carrying a ``"kind"`` field
+        so remote callers (the fleet router's
+        :class:`~tensorframes_tpu.serve.membership.RemoteEngine`) can
+        re-raise the exact exception class.
+
+        **Admission gate**: while the member's lifecycle state is
+        ``"draining"`` (rolling restart / SIGTERM) or ``"fenced"``
+        (lease lost — a zombie must not take traffic), new requests
+        answer 503 immediately — in-flight streams keep decoding;
+        probes during ``"probing"``/``"swapping"`` deliberately pass
+        (the rollout's validation traffic must reach the engine)."""
         import json
 
         t0 = time.perf_counter()
@@ -933,6 +1072,22 @@ class ScoringServer:
                 "501 Not Implemented",
                 {"error": "server has no generation engine"},
             )
+        if self._readiness is not None:
+            try:
+                _, _member_state = self._readiness()
+            except Exception:
+                _member_state = ""
+            if _member_state in ("draining", "fenced"):
+                return reply(
+                    "503 Service Unavailable",
+                    {"error": "member is draining (admission stopped; "
+                              "in-flight streams are finishing)"
+                     if _member_state == "draining"
+                     else "member was fenced (lease lost; re-register "
+                          "before admitting traffic)",
+                     "kind": "Draining"},
+                    {"Retry-After": "2"},
+                )
         from ..serve.engine import EngineUnhealthyError
         from ..serve.scheduler import QueueFullError
         from ..utils.config import get_config
@@ -943,6 +1098,7 @@ class ScoringServer:
             prompt = spec["prompt"]
             max_new = int(spec["max_new_tokens"])
             deadline = spec.get("deadline_s")
+            stream = bool(spec.get("stream", False)) and conn is not None
             kwargs: Dict[str, Any] = dict(
                 temperature=float(spec.get("temperature", 0.0)),
                 top_p=float(spec.get("top_p", 1.0)),
@@ -950,6 +1106,8 @@ class ScoringServer:
                 deadline=None if deadline is None else float(deadline),
                 block=False,
             )
+            if spec.get("eos_id") is not None:
+                kwargs["eos_id"] = int(spec["eos_id"])
             if spec.get("session") is not None:
                 # replica affinity — only the fleet router understands it
                 # (duck-typed on its replica surface; catching TypeError
@@ -989,7 +1147,10 @@ class ScoringServer:
             # the fleet router can notice a deadline expiring DURING
             # placement (DeadlineExceededError) — same 504 as a stream
             # that expired mid-generation
-            return reply("504 Gateway Timeout", {"error": str(e)})
+            return reply(
+                "504 Gateway Timeout",
+                {"error": str(e), "kind": type(e).__name__},
+            )
         except TenantThrottledError as e:
             # per-TENANT refusal (quota / rate bucket / SLO shed,
             # serve/tenancy.py) — the server has capacity, this tenant
@@ -1001,7 +1162,8 @@ class ScoringServer:
             retry = str(int(min(30, max(1, math.ceil(e.retry_after)))))
             return reply(
                 "429 Too Many Requests",
-                {"error": str(e), "tenant": e.tenant, "reason": e.reason},
+                {"error": str(e), "tenant": e.tenant, "reason": e.reason,
+                 "kind": "TenantThrottledError"},
                 {"Retry-After": retry},
             )
         except (QueueFullError, EngineUnhealthyError) as e:
@@ -1011,11 +1173,17 @@ class ScoringServer:
             # Retry-After adapts to the backlog (depth x p50 ITL).
             return reply(
                 "503 Service Unavailable",
-                {"error": str(e)},
+                {"error": str(e), "kind": type(e).__name__},
                 {"Retry-After": _adaptive_retry_after(self._engine)},
             )
         except ValueError as e:
-            return reply("400 Bad Request", {"error": str(e)})
+            return reply(
+                "400 Bad Request",
+                {"error": str(e), "kind": "ValueError"},
+            )
+        if stream:
+            self._stream_generate(conn, ctx, handle, t0)
+            return None
         try:
             toks = handle.result(
                 timeout=get_config().serve_result_timeout_s
@@ -1025,7 +1193,8 @@ class ScoringServer:
             # result-timeout backstop both mean the same thing upstream
             return reply(
                 "504 Gateway Timeout",
-                {"request_id": handle.request_id, "error": str(e)},
+                {"request_id": handle.request_id, "error": str(e),
+                 "kind": type(e).__name__},
                 handle=handle,
             )
         except Exception as e:  # engine-side failure closed the handle
@@ -1034,6 +1203,7 @@ class ScoringServer:
                 {
                     "request_id": handle.request_id,
                     "error": f"{type(e).__name__}: {e}",
+                    "kind": type(e).__name__,
                 },
                 handle=handle,
             )
@@ -1044,6 +1214,83 @@ class ScoringServer:
                 "tokens": [int(t) for t in toks],
             },
             handle=handle,
+        )
+
+    def _stream_generate(self, conn, ctx, handle, t0: float) -> None:
+        """The NDJSON success path of ``POST /generate`` with
+        ``"stream": true``: headers first (no Content-Length — the
+        stream's end is the connection's), then one ``{"t": token}``
+        line per emission as the engine emits it, then exactly one
+        terminal line — ``{"done": true, request_id, tokens_total,
+        trace_id, timing}`` or ``{"error", "kind", request_id}``. The
+        per-line flush is the point: a remote router relays each token
+        to its caller the moment it lands, and a member killed
+        mid-stream tears the connection, which the router treats as a
+        replayable replica fault (the emitted prefix folds into the
+        replay prompt — byte-identity preserved)."""
+        import json
+
+        from ..utils.config import get_config
+
+        conn.sendall(
+            (
+                "HTTP/1.1 200 OK\r\n"
+                "Content-Type: application/x-ndjson; charset=utf-8\r\n"
+                f"traceparent: {ctx.traceparent()}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode("latin-1")
+        )
+        sent = 0
+        timeout_s = get_config().serve_result_timeout_s
+        terminal: Dict[str, Any]
+        try:
+            while True:
+                try:
+                    item = handle._q.get(timeout=timeout_s)
+                except Exception:  # queue.Empty: the backstop fired
+                    terminal = {
+                        "error": f"no emission within {timeout_s}s",
+                        "kind": "TimeoutError",
+                        "request_id": handle.request_id,
+                    }
+                    break
+                if item is handle._DONE:
+                    err = handle.error
+                    if err is None:
+                        total = time.perf_counter() - t0
+                        terminal = {
+                            "done": True,
+                            "request_id": handle.request_id,
+                            "tokens_total": sent,
+                            "trace_id": ctx.trace_id,
+                            "timing": self._timing_payload(handle, total),
+                        }
+                    else:
+                        terminal = {
+                            "error": str(err),
+                            "kind": type(err).__name__,
+                            "request_id": handle.request_id,
+                        }
+                    break
+                conn.sendall(
+                    (json.dumps({"t": int(item)}) + "\n").encode("utf-8")
+                )
+                sent += 1
+            conn.sendall((json.dumps(terminal) + "\n").encode("utf-8"))
+            status = "200" if terminal.get("done") else "error"
+        except OSError:
+            # the client went away mid-stream (a fenced router, a killed
+            # process): nothing to answer — the engine-side stream keeps
+            # its own lifecycle and the relay identity gate upstream
+            # drops whatever else this request emits
+            status = "client-gone"
+        _flight.record(
+            "serving", "generate_stream",
+            status=status,
+            trace_id=ctx.trace_id,
+            tokens=sent,
+            request_id=handle.request_id,
+            dur_s=round(time.perf_counter() - t0, 6),
         )
 
     def _serve_one(self, conn: socket.socket) -> None:

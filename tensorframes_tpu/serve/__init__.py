@@ -19,10 +19,24 @@ non-speculative decode). See ``docs/serving_llm.md``.
 - :mod:`.fleet` — N engine replicas behind a health-gated router with
   least-loaded/session-affinity placement, fencing + background
   restart, and request replay on replica death
+- :mod:`.membership` — the multi-host tier: lease-based membership in
+  a shared directory, remote replicas over HTTP, host-death fencing,
+  rolling restarts / hot weight swaps, autoscaling hooks
 """
 
 from .engine import EngineUnhealthyError, GenerationEngine
 from .fleet import Fleet, FleetHandle
+from .membership import (
+    Autoscaler,
+    MemberAgent,
+    MemberRegistry,
+    RemoteEngine,
+    connect_fleet,
+    load_params,
+    rolling_restart,
+    rolling_weight_swap,
+    save_params,
+)
 from .kv_pages import (
     PageGroup,
     PagePool,
@@ -33,17 +47,26 @@ from .kv_pages import (
 from .scheduler import GenerationHandle, GenRequest, QueueFullError, Scheduler
 
 __all__ = [
+    "Autoscaler",
     "EngineUnhealthyError",
     "Fleet",
     "FleetHandle",
     "GenerationEngine",
     "GenerationHandle",
     "GenRequest",
+    "MemberAgent",
+    "MemberRegistry",
     "PageGroup",
     "PagePool",
     "PrefixCache",
     "QueueFullError",
+    "RemoteEngine",
     "Scheduler",
     "SequencePages",
+    "connect_fleet",
+    "load_params",
     "pages_needed",
+    "rolling_restart",
+    "rolling_weight_swap",
+    "save_params",
 ]

@@ -2197,6 +2197,84 @@ class GenerationEngine:
         )
         return self
 
+    def swap_weights(self, model) -> Dict[str, object]:
+        """Hot weight swap: replace the served checkpoint in place.
+
+        Weights enter every compiled step program as an ARGUMENT (the
+        swap-safe design noted at construction), so swapping is one
+        ``device_put`` plus a pointer flip under the step lock — **zero
+        recompiles** (shapes and dtypes are validated identical, so the
+        jit caches all hit) and zero dropped streams (in-flight
+        sequences simply decode their next token under the new
+        weights; the step between old and new is a clean boundary
+        because the lock excludes a half-dispatched step).
+
+        ``model`` is a :class:`~tensorframes_tpu.models.TransformerLM`
+        or its raw params dict. A checkpoint whose tree structure,
+        shapes, dtypes, or head count differ raises ``ValueError``
+        *before* anything is touched — the rollout machinery
+        (``serve/membership.py``) treats that exactly like a failed
+        probe: roll back, halt the rollout. Returns the PREVIOUS params
+        dict so callers can roll back with a second ``swap_weights``.
+        Under tensor parallelism the new copy is sharded at rest with
+        the same specs as the original (structure equality makes them
+        reusable)."""
+        import jax
+
+        params = getattr(model, "params", model)
+        if not isinstance(params, dict) or "blocks" not in params:
+            raise ValueError(
+                "swap_weights expects a TransformerLM or its params "
+                f"dict; got {type(params).__name__}"
+            )
+        old = self._host_params
+        if int(params.get("n_heads", 0)) != int(old.get("n_heads", 0)):
+            raise ValueError(
+                f"swap_weights: head count mismatch (served "
+                f"{old.get('n_heads')}, checkpoint {params.get('n_heads')})"
+            )
+        new_host = {k: v for k, v in params.items() if k != "n_heads"}
+        old_host = {k: v for k, v in old.items() if k != "n_heads"}
+
+        def _sig(tree):
+            return jax.tree.map(
+                lambda a: (tuple(a.shape), str(np.dtype(a.dtype))), tree
+            )
+
+        if jax.tree.structure(new_host) != jax.tree.structure(old_host):
+            raise ValueError(
+                "swap_weights: checkpoint tree structure differs from "
+                "the served weights — same architecture required for a "
+                "hot swap"
+            )
+        if _sig(new_host) != _sig(old_host):
+            raise ValueError(
+                "swap_weights: checkpoint shapes/dtypes differ from the "
+                "served weights — same shapes required (a shape change "
+                "would recompile every step program; restart instead)"
+            )
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            dev = jax.device_put(
+                new_host,
+                jax.tree.map(
+                    lambda s: NamedSharding(self.mesh, s),
+                    self._tp_param_specs,
+                    is_leaf=lambda x: not isinstance(x, (dict, list)),
+                ),
+            )
+        else:
+            dev = jax.device_put(new_host)
+        with self._step_lock:
+            self._params_dev = dev
+            self._host_params = params
+        _flight.record("serve", "weight_swap", engine=self.name)
+        logger.info(
+            "engine %s: weights hot-swapped (zero recompiles)", self.name
+        )
+        return old
+
     def health(self) -> Dict[str, object]:
         """Liveness snapshot for ``GET /healthz``: the last-step watchdog
         age, queue/batch/pool occupancy, and the unhealthy flags the

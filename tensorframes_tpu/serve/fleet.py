@@ -244,10 +244,13 @@ class _StreamComplete(Exception):
 
 class _Replica:
     """One engine plus its gate state. ``active`` replicas accept
-    placements; ``fenced`` ones are draining/restarting. ``wedged``
-    marks a fence whose stepping thread never exited (a stuck device
-    call) — auto-restart skips those, since ``restart()`` would block on
-    the lock the wedged step still holds; recycle the process."""
+    placements; ``fenced`` ones are draining/restarting; ``draining``
+    ones finish their in-flight streams but take no NEW placements (the
+    rolling-restart / graceful-shutdown gate — an administrative state,
+    not a failure). ``wedged`` marks a fence whose stepping thread never
+    exited (a stuck device call) — auto-restart skips those, since
+    ``restart()`` would block on the lock the wedged step still holds;
+    recycle the process."""
 
     __slots__ = ("name", "engine", "state", "wedged", "restarting", "lock")
 
@@ -295,12 +298,17 @@ class Fleet:
       door: replicas of different TP degree (``mesh=...``) coexist
       behind one router, and failover replay ACROSS degrees stays
       byte-identical because every degree emits the same bytes
-      (``serve/tp.py``).
+      (``serve/tp.py``);
+    - ``engines`` — pre-built ``(name, engine)`` pairs instead of a
+      model + construction kwargs: the elastic-membership door
+      (``serve/membership.py``) where the router fronts remote-replica
+      adapters it did not construct and the roster grows/shrinks at
+      runtime as members register and resign.
     """
 
     def __init__(
         self,
-        model,
+        model=None,
         *,
         replicas: int = 2,
         watchdog_interval_s: float = 0.05,
@@ -310,9 +318,27 @@ class Fleet:
         failover_timeout_s: float = 60.0,
         auto_restart: bool = True,
         replica_kwargs: Optional[Sequence[Dict]] = None,
+        engines: Optional[Sequence[Tuple[str, object]]] = None,
         **engine_kwargs,
     ):
-        if replicas < 1:
+        if engines is not None:
+            # pre-built engine injection — the elastic-membership door
+            # (serve/membership.py): the router fronts engines it did
+            # NOT construct (remote-replica adapters, an empty roster
+            # that fills as members register). Construction kwargs are
+            # meaningless here, so mixing the modes is a caller bug.
+            if model is not None or replica_kwargs is not None or engine_kwargs:
+                raise ValueError(
+                    "engines= is mutually exclusive with model/"
+                    "replica_kwargs/engine construction kwargs — the "
+                    "injected engines are already built"
+                )
+            names = [str(n) for n, _ in engines]
+            if len(set(names)) != len(names):
+                raise ValueError(f"duplicate replica names in engines=: {names}")
+        elif model is None:
+            raise ValueError("need a model (or pre-built engines=)")
+        elif replicas < 1:
             raise ValueError(f"need replicas >= 1; got {replicas}")
         if replica_kwargs is not None:
             if len(replica_kwargs) != replicas:
@@ -346,24 +372,34 @@ class Fleet:
         # like same-shape failover. Overrides that change emitted
         # streams (the model, top_k, eos_id) are the caller's contract
         # to keep identical, as ever.
-        self._replicas: List[_Replica] = [
-            _Replica(
-                f"r{i}",
-                GenerationEngine(
-                    model,
-                    name=f"r{i}",
-                    **{
-                        **engine_kwargs,
-                        **(
-                            replica_kwargs[i]
-                            if replica_kwargs is not None
-                            else {}
-                        ),
-                    },
-                ),
-            )
-            for i in range(int(replicas))
-        ]
+        #
+        # ``self._replicas`` is rebound copy-on-write (never mutated in
+        # place) so the router's lock-free sweeps iterate a consistent
+        # snapshot while members join and leave (:meth:`_add_replica` /
+        # :meth:`_remove_replica`).
+        if engines is not None:
+            self._replicas: List[_Replica] = [
+                _Replica(str(name), eng) for name, eng in engines
+            ]
+        else:
+            self._replicas = [
+                _Replica(
+                    f"r{i}",
+                    GenerationEngine(
+                        model,
+                        name=f"r{i}",
+                        **{
+                            **engine_kwargs,
+                            **(
+                                replica_kwargs[i]
+                                if replica_kwargs is not None
+                                else {}
+                            ),
+                        },
+                    ),
+                )
+                for i in range(int(replicas))
+            ]
         self.watchdog_interval_s = float(watchdog_interval_s)
         self.wedge_timeout_s = float(wedge_timeout_s)
         self.probe_timeout_s = float(probe_timeout_s)
@@ -385,6 +421,11 @@ class Fleet:
         self._stop_evt = threading.Event()
         self._wake = threading.Event()
         self._closed = False
+        #: callables run once per router tick (after health polling,
+        #: before the failover drain) — the membership layer's sync
+        #: point: registry scans, autoscaler evaluation. A hook that
+        #: raises is logged and kept; it must not kill the watchdog.
+        self._tick_hooks: List = []
         _m_replicas_healthy.set(float(len(self._replicas)))
 
     # -- introspection -----------------------------------------------------
@@ -831,7 +872,8 @@ class Fleet:
             return True
         eos = rec.eos_id
         if eos is None:
-            eos = self._replicas[0].engine.eos_id  # replicas are identical
+            reps = self._replicas  # snapshot: the roster may be elastic
+            eos = reps[0].engine.eos_id if reps else None
         return eos is not None and bool(emitted) and emitted[-1] == eos
 
     def _replay(self, rec: _FleetRequest) -> bool:
@@ -971,9 +1013,12 @@ class Fleet:
     ) -> None:
         """Gate a replica out: no new placements, and every attached
         handle fails NOW so its survivors hit the failover queue instead
-        of hanging against an engine that will never step them."""
+        of hanging against an engine that will never step them. A
+        ``draining`` replica fences too — an administrative drain does
+        not immunize a replica against dying, and its in-flight streams
+        still deserve the replay path."""
         with rep.lock:
-            if rep.state != "active":
+            if rep.state not in ("active", "draining"):
                 return
             rep.state = "fenced"
             rep.wedged = wedged
@@ -1043,6 +1088,142 @@ class Fleet:
         except Exception:
             pass  # the fence is the fault; corruption is the drill's color
 
+    def _probe_engine(self, eng) -> None:
+        """One probe generation — a token through prefill AND decode —
+        that must succeed before a replica (re)takes traffic. Raises on
+        failure; shared by the restart worker, :meth:`probe_replica`,
+        and the membership layer's admission/weight-swap gates."""
+        probe_new = max(1, min(2, eng.max_seq_len - 1))
+        probe = eng.submit(
+            [1], probe_new, block=False, deadline=self.probe_timeout_s
+        )
+        if eng._thread is None:
+            eng.run_until_idle()  # fleet not started: drive it inline
+        probe.result(timeout=self.probe_timeout_s)
+
+    def probe_replica(self, name: str) -> bool:
+        """Run one probe generation against a replica WITHOUT touching
+        its gate state — the health check the rolling weight swap runs
+        on a drained member before re-admitting it. Returns whether the
+        probe produced a token in time."""
+        rep = self._replica(name)
+        try:
+            self._probe_engine(rep.engine)
+            return True
+        except Exception:
+            logger.warning(
+                "fleet: replica %s probe failed", rep.name, exc_info=True
+            )
+            return False
+
+    def drain_replica(self, name: str) -> bool:
+        """Administratively gate a replica out of NEW placements while
+        its in-flight streams finish on it (the first step of a rolling
+        restart / weight swap — a drain, not a fence: nothing fails).
+        Session pins to the replica are dropped so affine traffic
+        re-places immediately. Returns False unless the replica was
+        active."""
+        rep = self._replica(name)
+        with rep.lock:
+            if rep.state != "active":
+                return False
+            rep.state = "draining"
+        with self._lock:
+            victims = [
+                s for s, (r, _) in self._sessions.items() if r is rep
+            ]
+            for s in victims:
+                del self._sessions[s]
+        _flight.record(
+            "fleet", "drain", replica=rep.name, sessions_dropped=len(victims)
+        )
+        logger.warning(
+            "fleet: replica %s draining (no new placements; %d session "
+            "pin(s) dropped)",
+            rep.name,
+            len(victims),
+        )
+        self._wake.set()
+        return True
+
+    def admit_replica(self, name: str, probe: bool = True) -> bool:
+        """Re-admit a drained or fenced replica to placement, gated on a
+        probe generation by default (re-admitting a replica that cannot
+        generate would just bounce traffic). The administrative twin of
+        the restart worker's re-admission — it does NOT restart the
+        engine first; callers that recycled the process or swapped
+        weights already did. Returns whether the replica is active
+        afterwards."""
+        rep = self._replica(name)
+        with rep.lock:
+            if rep.state == "active":
+                return True
+            if rep.wedged or rep.restarting:
+                return False
+        if probe:
+            try:
+                self._probe_engine(rep.engine)
+            except Exception:
+                logger.warning(
+                    "fleet: replica %s admission probe failed; it stays "
+                    "%s",
+                    rep.name,
+                    rep.state,
+                    exc_info=True,
+                )
+                return False
+        with rep.lock:
+            if rep.wedged or rep.restarting:
+                return False  # fenced wedged while the probe ran
+            rep.state = "active"
+        _flight.record("fleet", "admit", replica=rep.name)
+        logger.warning("fleet: replica %s re-admitted", rep.name)
+        self._wake.set()
+        return True
+
+    def _add_replica(self, name: str, engine) -> None:
+        """Grow the roster by one pre-built engine (a member joining the
+        elastic fleet). Copy-on-write rebind: concurrent placement and
+        watchdog sweeps keep iterating their snapshot."""
+        rep = _Replica(str(name), engine)
+        with self._lock:
+            if any(r.name == rep.name for r in self._replicas):
+                raise ValueError(f"replica {rep.name!r} already exists")
+            self._replicas = self._replicas + [rep]
+        if self._thread is not None and engine._thread is None:
+            try:
+                engine.start()
+            except Exception:
+                logger.warning(
+                    "fleet: replica %s failed to start on join",
+                    rep.name,
+                    exc_info=True,
+                )
+        _flight.record("fleet", "replica_join", replica=rep.name)
+        self._wake.set()
+
+    def _remove_replica(self, name: str) -> Optional[_Replica]:
+        """Shrink the roster (a departed/fenced member leaving the
+        elastic fleet). Session pins to the removed replica are dropped;
+        the replica object is returned so the caller can drain or stop
+        its engine. Unknown names return None (removal is idempotent —
+        registry sweeps may race)."""
+        with self._lock:
+            rep = next(
+                (r for r in self._replicas if r.name == name), None
+            )
+            if rep is None:
+                return None
+            self._replicas = [r for r in self._replicas if r is not rep]
+            victims = [
+                s for s, (r, _) in self._sessions.items() if r is rep
+            ]
+            for s in victims:
+                del self._sessions[s]
+        _flight.record("fleet", "replica_leave", replica=rep.name)
+        self._wake.set()
+        return rep
+
     def restart_replica(self, name: str) -> bool:
         """Manually restart + probe + re-admit a fenced replica (the
         ``auto_restart=False`` path). A no-op on an active replica
@@ -1092,13 +1273,7 @@ class Fleet:
                     rep.name,
                 )
                 return
-            probe_new = max(1, min(2, eng.max_seq_len - 1))
-            probe = eng.submit(
-                [1], probe_new, block=False, deadline=self.probe_timeout_s
-            )
-            if eng._thread is None:
-                eng.run_until_idle()  # fleet not started: drive it inline
-            probe.result(timeout=self.probe_timeout_s)
+            self._probe_engine(eng)
             if self._stop_evt.is_set() or self._closed:
                 return  # stopped mid-probe: stay fenced, stay quiet
             with rep.lock:
@@ -1129,7 +1304,7 @@ class Fleet:
                 except Exception as e:
                     self._kill_replica(rep, e)
             h = rep.engine.health()
-            if rep.state == "active":
+            if rep.state in ("active", "draining"):
                 wedged = (
                     h["last_step_age_s"] > self.wedge_timeout_s
                     and (h["queue_depth"] > 0 or h["active_slots"] > 0)
@@ -1189,6 +1364,13 @@ class Fleet:
         try:
             while not self._stop_evt.is_set():
                 self._poll_replicas()
+                for hook in list(self._tick_hooks):
+                    try:
+                        hook()
+                    except Exception:
+                        logger.warning(
+                            "fleet: tick hook %r failed", hook, exc_info=True
+                        )
                 self._drain_failovers()
                 self._wake.wait(self.watchdog_interval_s)
                 self._wake.clear()

@@ -143,6 +143,8 @@ SITES = (
     "frame.d2h",
     "fleet.place",
     "fleet.replica_fault",
+    "fleet.member_heartbeat",
+    "fleet.registry",
     "tune.trial",
     "tenancy.admit",
 )

@@ -141,6 +141,18 @@ class Config:
     #: means ``job_lease_ttl_s / 3`` — three chances to renew before
     #: expiry. Per-worker override: ``run_worker(heartbeat_s=)``.
     job_heartbeat_s: float = 0.0
+    #: serving-fleet membership lease TTL (``serve/membership.py``): a
+    #: member whose registry heartbeats stall longer than this is
+    #: presumed dead, fenced by the router (epoch tombstone — its late
+    #: registry writes raise ``StaleLeaseError``), and its in-flight
+    #: streams are replayed on survivors. Shorter than the job TTL:
+    #: serving failover is latency-sensitive where batch reclamation is
+    #: not. Per-member override: ``MemberRegistry(ttl_s=)``.
+    member_lease_ttl_s: float = 10.0
+    #: membership heartbeat renewal interval. ``0`` (default) means
+    #: ``member_lease_ttl_s / 3``. Per-member override:
+    #: ``MemberRegistry(heartbeat_s=)``.
+    member_heartbeat_s: float = 0.0
     #: directory for the flight recorder's debug bundles
     #: (``obs/flight.py``: the JSON dumped on an engine fatal,
     #: ``restart()``, block quarantine, or write-fence reject). Empty

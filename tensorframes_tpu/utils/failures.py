@@ -122,6 +122,7 @@ _TRANSIENT_MARKERS = (
     "deadline_exceeded",
     "aborted",
     "connection reset",
+    "connection refused",
     "socket closed",
 )
 
@@ -188,11 +189,14 @@ class QuarantinedBlocksError(RuntimeError):
 
 
 class StaleLeaseError(RuntimeError):
-    """A distributed-job write was fenced off, or a journal is busy.
+    """An epoch-fenced write was rejected: the lease is not ours.
 
-    Raised by the distributed batch-job layer (``engine/dist_jobs.py``)
-    in two situations that share one meaning — *this process does not
-    own the journal state it is about to mutate*:
+    Raised by the lease primitive (``utils/leases.py``) and both of its
+    tenants — the distributed batch-job layer (``engine/dist_jobs.py``)
+    and the serving fleet's member registry (``serve/membership.py``,
+    where a fenced member's late registration write is the "zombie
+    process" rejection) — in situations that share one meaning — *this
+    process does not own the shared state it is about to mutate*:
 
     - a worker whose block lease expired and was **reclaimed** by
       another worker (epoch bumped) tries to record its late result:

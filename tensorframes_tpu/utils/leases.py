@@ -1,0 +1,475 @@
+"""Epoch-stamped filesystem leases: the reusable coordination primitive.
+
+``engine/dist_jobs.py`` introduced the trick — a lease for key ``k`` at
+epoch ``e`` is the file ``<key>.e{epoch:06d}.lease``, created by
+hard-linking a fully written temp file, so claiming any (key, epoch)
+pair is atomic create-if-absent with exactly one winner and **no lock
+server**: the directory is the membership table, the epoch in the
+filename is the monotonic fencing token, and "current lease" is simply
+the key's highest-epoch file. PR 18 needs the same machinery for a
+second tenant — the serving fleet's member registry
+(:mod:`tensorframes_tpu.serve.membership`) — so the mechanics live
+here as :class:`LeaseStore` and both planes subclass it rather than
+duplicating 300 lines of carefully ordered filesystem races:
+
+- **atomic claim** (:meth:`LeaseStore.acquire`) — exclusive create of
+  the next epoch file; reclaiming an expired lease is an exclusive
+  race for ``epoch + 1``.
+- **heartbeats** — a daemon thread rewrites every held lease with a
+  fresh deadline every ``heartbeat_s`` (default ``ttl / 3``); each
+  renewal *re-validates ownership first* (the current file must still
+  carry our worker + epoch), because a blind ``os.replace`` would
+  re-create a superseded file a reclaimer already unlinked — a phantom
+  stale lease renewed forever. A lease found stolen is dropped and
+  reported through the ``on_lost`` hook (how a fenced serving member
+  learns it has been presumed dead).
+- **write fencing** (:meth:`LeaseStore.publish`) — every mutation of a
+  held lease re-validates ownership immediately before the rewrite and
+  raises :class:`~tensorframes_tpu.utils.failures.StaleLeaseError`
+  when superseded: a zombie process that wakes after its lease was
+  stolen cannot silently re-assert itself.
+- **tombstones** (:meth:`LeaseStore.steal`) — a third party fences a
+  presumed-dead owner by winning the ``epoch + 1`` race with a
+  terminal state (``"fenced"``/``"done"``), exactly the dist-jobs
+  reclaim but with a marker instead of a recompute.
+
+Payloads are JSON — ``{worker, epoch, state, deadline_unix,
+written_unix}`` plus an optional free-form ``meta`` dict (how a
+serving member advertises its URL and model shape). Liveness vs
+safety: ``deadline_unix`` compares against the *local* clock, so the
+TTL must comfortably exceed heartbeat jitter + filesystem latency +
+inter-host clock skew.
+
+Subclass policy lives with the subclass: :class:`LeaseManager` keeps
+the journal/block handshake, job metrics and ``jobs.*`` chaos sites;
+the member registry adds lifecycle metadata and ``fleet.*`` chaos
+sites. This module stays dependency-free below :mod:`..utils`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import get_logger
+from .failures import StaleLeaseError
+
+__all__ = ["LeaseStore", "LeaseView"]
+
+logger = get_logger("leases")
+
+_LEASE_DIR = "leases"
+
+
+@dataclass
+class LeaseView:
+    """Parsed view of one lease key's CURRENT (highest-epoch) file."""
+
+    key: str
+    epoch: int
+    worker: str
+    deadline_unix: float
+    state: str  # "live" (held or expired — check the deadline) | terminal
+    fname: str
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def expired(self) -> bool:
+        return self.state == "live" and self.deadline_unix <= time.time()
+
+    @property
+    def terminal(self) -> bool:
+        """A non-"live" state is a tombstone — never reclaimable at
+        this epoch ("done" for recorded job blocks, "fenced" for
+        presumed-dead serving members)."""
+        return self.state != "live"
+
+
+class LeaseStore:
+    """Filesystem lease table under ``<path>/leases/``.
+
+    Epoch-in-the-filename is the whole trick: creating
+    ``<key>.e{epoch:06d}.lease`` is atomic create-if-absent (hard link
+    of a fully written temp file), so claiming any (key, epoch) pair
+    has exactly one winner, reclamation is an exclusive race for
+    ``epoch + 1``, and the epoch doubles as the monotonic **fencing
+    token** stamped into every downstream write. The current lease for
+    a key is simply its highest-epoch file."""
+
+    def __init__(
+        self,
+        path: str,
+        worker_id: str,
+        ttl_s: float,
+        heartbeat_s: float = 0.0,
+        create: bool = True,
+    ):
+        if ttl_s <= 0:
+            raise ValueError(f"lease ttl must be > 0; got {ttl_s}")
+        self.root = path
+        self.dir = os.path.join(path, _LEASE_DIR)
+        if create:
+            os.makedirs(self.dir, exist_ok=True)
+        self.worker_id = worker_id
+        self.ttl_s = float(ttl_s)
+        self.heartbeat_s = float(heartbeat_s) or self.ttl_s / 3.0
+        self._lock = threading.Lock()
+        #: key -> (epoch, fname) for leases this store holds live
+        self._held: Dict[str, Tuple[int, str]] = {}
+        self._stop = threading.Event()
+        self._hb: Optional[threading.Thread] = None
+        #: called (key, epoch, current_view_or_None) when a heartbeat
+        #: sweep discovers a held lease was stolen underneath us — the
+        #: "you were presumed dead and fenced" signal
+        self.on_lost: Optional[
+            Callable[[str, int, Optional[LeaseView]], None]
+        ] = None
+
+    # -- scanning ----------------------------------------------------------
+
+    def _scan(self, key: str) -> Optional[LeaseView]:
+        """The key's current lease: its highest-epoch file, parsed. An
+        unreadable file (a crash artifact — every write here is a
+        link/rename of complete content, so this should not happen)
+        reads as an expired live lease, i.e. reclaimable."""
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return None
+        prefix = key + ".e"
+        best: Optional[Tuple[int, str]] = None
+        for n in names:
+            if not (n.startswith(prefix) and n.endswith(".lease")):
+                continue
+            try:
+                epoch = int(n[len(prefix):-len(".lease")])
+            except ValueError:
+                continue
+            if best is None or epoch > best[0]:
+                best = (epoch, n)
+        if best is None:
+            return None
+        return self._read_view(key, best[0], best[1])
+
+    def _read_view(self, key: str, epoch: int, fname: str) -> LeaseView:
+        try:
+            with open(os.path.join(self.dir, fname), "r") as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            d = {}
+        meta = d.get("meta")
+        return LeaseView(
+            key=key,
+            epoch=epoch,
+            worker=str(d.get("worker", "")),
+            deadline_unix=float(d.get("deadline_unix", 0.0)),
+            state=str(d.get("state", "live")),
+            fname=fname,
+            meta=dict(meta) if isinstance(meta, dict) else {},
+        )
+
+    def scan_all(self) -> List[LeaseView]:
+        """Current lease view of every key: ONE directory listing,
+        grouped by key with the max epoch kept, then one file read per
+        key — not a per-key re-listing (O(keys²) on big tables)."""
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return []
+        best: Dict[str, Tuple[int, str]] = {}
+        for n in names:
+            if not n.endswith(".lease"):
+                continue
+            key, sep, rest = n[: -len(".lease")].rpartition(".e")
+            if not sep:
+                continue
+            try:
+                epoch = int(rest)
+            except ValueError:
+                continue
+            cur = best.get(key)
+            if cur is None or epoch > cur[0]:
+                best[key] = (epoch, n)
+        return [
+            self._read_view(key, epoch, fname)
+            for key, (epoch, fname) in sorted(best.items())
+        ]
+
+    def held_epoch(self, key: str) -> Optional[int]:
+        """The epoch this store holds ``key`` at, or ``None``."""
+        with self._lock:
+            held = self._held.get(key)
+        return None if held is None else held[0]
+
+    # -- claiming ----------------------------------------------------------
+
+    def _payload(
+        self,
+        epoch: int,
+        state: str = "live",
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> bytes:
+        d: Dict[str, Any] = {
+            "worker": self.worker_id,
+            "epoch": epoch,
+            "state": state,
+            "deadline_unix": time.time() + self.ttl_s,
+            "written_unix": time.time(),
+        }
+        if meta:
+            d["meta"] = meta
+        return json.dumps(d).encode("utf-8")
+
+    def _create_excl(self, fname: str, payload: bytes) -> bool:
+        """Atomically create ``fname`` with ``payload`` iff absent:
+        write a private temp file completely, then hard-link it to the
+        target — EEXIST means another worker won the epoch."""
+        target = os.path.join(self.dir, fname)
+        tmp = os.path.join(
+            self.dir, f".tmp-{self.worker_id}-{uuid.uuid4().hex[:8]}"
+        )
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+        try:
+            os.link(tmp, target)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def acquire(
+        self, key: str, meta: Optional[Dict[str, Any]] = None
+    ) -> Optional[int]:
+        """Claim (or reclaim) ``key``; one attempt, no retries (policy
+        subclasses wrap with ``run_with_retries`` and their chaos
+        sites). Returns the held epoch, or ``None`` when the key is
+        terminal at its current epoch, live-leased elsewhere, or the
+        exclusive race was lost."""
+        now = time.time()
+        with self._lock:
+            held = self._held.get(key)
+        cur = self._scan(key)
+        if held is not None:
+            if cur is not None and cur.epoch == held[0]:
+                return held[0]  # still ours (epoch files are exclusive)
+            # superseded or deleted underneath us: we lost it (and our
+            # old epoch file, if a heartbeat resurrected it, is dead
+            # weight — drop it so it cannot linger as a phantom stale
+            # lease)
+            self._drop_held(key, held[0], held[1])
+        if cur is None:
+            epoch = 0
+        elif cur.terminal:
+            return None  # tombstoned at this epoch
+        elif cur.deadline_unix > now:
+            return None  # live, someone else's
+        else:
+            epoch = cur.epoch + 1
+        fname = f"{key}.e{epoch:06d}.lease"
+        if not self._create_excl(fname, self._payload(epoch, meta=meta)):
+            return None  # lost the exclusive race for this epoch
+        with self._lock:
+            self._held[key] = (epoch, fname)
+        self._ensure_heartbeat()
+        if epoch > 0:
+            self._unlink_superseded(key, epoch)
+        return epoch
+
+    def steal(
+        self,
+        key: str,
+        state: str = "fenced",
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> Optional[int]:
+        """Fence ``key``'s current owner: win the exclusive race for
+        ``epoch + 1`` with a terminal ``state`` tombstone. The stolen
+        lease is NOT held (no heartbeat — tombstones carry no
+        liveness); the loser's next fenced write raises
+        :class:`StaleLeaseError` and its heartbeat drops the lease.
+        Returns the tombstone epoch, or ``None`` when the key is
+        unknown, already terminal, or the race was lost."""
+        cur = self._scan(key)
+        if cur is None or cur.terminal:
+            return None
+        epoch = cur.epoch + 1
+        fname = f"{key}.e{epoch:06d}.lease"
+        if not self._create_excl(
+            fname, self._payload(epoch, state=state, meta=meta)
+        ):
+            return None
+        self._unlink_superseded(key, epoch)
+        return epoch
+
+    def _unlink_superseded(self, key: str, epoch: int) -> None:
+        """Housekeeping: epoch files below ``epoch`` are dead weight."""
+        for old in range(epoch):
+            try:
+                os.unlink(
+                    os.path.join(self.dir, f"{key}.e{old:06d}.lease")
+                )
+            except OSError:
+                pass
+
+    # -- renewal / publication / release -----------------------------------
+
+    def _rewrite(self, fname: str, payload: bytes) -> None:
+        target = os.path.join(self.dir, fname)
+        tmp = target + f".w-{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+        os.replace(tmp, target)
+
+    def publish(self, key: str, meta: Dict[str, Any]) -> int:
+        """Fenced metadata write: re-validate ownership, then rewrite
+        the held lease with ``meta`` and a fresh deadline. Raises
+        :class:`StaleLeaseError` when the lease is not held or was
+        stolen — the zombie-write rejection: a process that wakes after
+        being fenced cannot re-assert its registration."""
+        with self._lock:
+            held = self._held.get(key)
+        cur = self._scan(key)
+        if (
+            held is None
+            or cur is None
+            or cur.epoch != held[0]
+            or cur.worker != self.worker_id
+        ):
+            if held is not None:
+                self._drop_held(key, held[0], held[1])
+            if cur is None:
+                detail = "the lease file is gone"
+            else:
+                detail = (
+                    f"superseded by epoch {cur.epoch} "
+                    f"(worker {cur.worker!r}, state {cur.state})"
+                )
+            raise StaleLeaseError(
+                f"worker {self.worker_id}: lease {key!r} is stale — "
+                f"{detail}; dropping the late write"
+            )
+        epoch, fname = held
+        with self._lock:
+            if self._held.get(key) != (epoch, fname):
+                raise StaleLeaseError(
+                    f"worker {self.worker_id}: lease {key!r} released "
+                    f"during publish"
+                )
+            self._rewrite(fname, self._payload(epoch, meta=meta))
+        return epoch
+
+    def renew_all(
+        self, meta_for: Optional[Callable[[str], Optional[dict]]] = None
+    ) -> int:
+        """One heartbeat sweep: rewrite every held lease with a fresh
+        deadline (and, via ``meta_for``, refreshed metadata). Each
+        renewal re-validates ownership BEFORE rewriting — ``_rewrite``
+        is an ``os.replace``, which would re-CREATE a superseded file
+        the reclaimer's housekeeping already unlinked, a phantom stale
+        lease this worker would then renew forever. Returns the number
+        of leases actually renewed."""
+        renewed = 0
+        for key, (epoch, fname) in list(self._held.items()):
+            cur = self._scan(key)
+            if (
+                cur is None
+                or cur.epoch != epoch
+                or cur.worker != self.worker_id
+            ):
+                self._drop_held(key, epoch, fname)
+                if self.on_lost is not None:
+                    try:
+                        self.on_lost(key, epoch, cur)
+                    except Exception:
+                        logger.warning(
+                            "worker %s: on_lost hook failed for %s",
+                            self.worker_id, key, exc_info=True,
+                        )
+                continue
+            meta = meta_for(key) if meta_for is not None else None
+            if meta is None and cur.meta:
+                meta = cur.meta  # carry registration metadata forward
+            with self._lock:
+                if self._held.get(key) != (epoch, fname):
+                    continue  # finished/released between snapshot and now
+                self._rewrite(fname, self._payload(epoch, meta=meta))
+            renewed += 1
+        return renewed
+
+    def _drop_held(self, key: str, epoch: int, fname: str) -> None:
+        """Forget a lease we no longer own and unlink our (now
+        superseded) epoch file if it still exists — never the current
+        one, which has a different epoch in its name."""
+        with self._lock:
+            if self._held.get(key) == (epoch, fname):
+                self._held.pop(key, None)
+        try:
+            os.unlink(os.path.join(self.dir, fname))
+        except OSError:
+            pass
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self._heartbeat_sweep()
+            except Exception:
+                # a failed sweep is survivable until the TTL runs out;
+                # the next tick retries. Never kill the thread.
+                logger.warning(
+                    "worker %s: lease heartbeat sweep failed",
+                    self.worker_id, exc_info=True,
+                )
+
+    def _heartbeat_sweep(self) -> None:
+        """The per-tick body of the heartbeat thread; subclasses wrap
+        it with their chaos site + renewal metrics."""
+        self.renew_all()
+
+    def _ensure_heartbeat(self) -> None:
+        if self._hb is None or not self._hb.is_alive():
+            self._hb = threading.Thread(
+                target=self._hb_loop,
+                name=f"tft-lease-hb-{self.worker_id}",
+                daemon=True,
+            )
+            self._hb.start()
+
+    def mark_state(self, key: str, state: str) -> None:
+        """Terminal marker: rewrite a held lease as ``state`` (a
+        tombstone — "done" for recorded blocks, "resigned" for cleanly
+        departing members) and stop heartbeating it."""
+        with self._lock:
+            held = self._held.pop(key, None)
+            if held is not None:
+                self._rewrite(held[1], self._payload(held[0], state=state))
+
+    def release_key(self, key: str) -> None:
+        """Drop a lease and unlink its file — the key becomes claimable
+        again at the same epoch lineage."""
+        with self._lock:
+            held = self._held.pop(key, None)
+            if held is not None:
+                try:
+                    os.unlink(os.path.join(self.dir, held[1]))
+                except OSError:
+                    pass
+
+    def stop(self, unlink_held: bool = True) -> None:
+        """Stop heartbeats and (by default) release everything held so
+        other workers need not wait out the TTL."""
+        self._stop.set()
+        if self._hb is not None:
+            self._hb.join(timeout=self.heartbeat_s + 5.0)
+        if unlink_held:
+            for key in list(self._held):
+                self.release_key(key)

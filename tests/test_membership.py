@@ -1,0 +1,946 @@
+"""Elastic multi-host serving fleet: lease membership, host-death
+failover, rolling restarts / weight swaps (serve/membership.py).
+
+The correctness bar is test_fleet.py's, raised one tier again: a stream
+decoded through a fleet of SEPARATE serving processes — placed over
+HTTP on some member, possibly killed mid-stream (the member, not the
+request) and replayed on a survivor — must stay BYTE-IDENTICAL to the
+same request decoded alone, greedy and seeded sampling alike. The fast
+suite runs the whole topology in-process (real ScoringServer sockets,
+real NDJSON relays, real lease files; only the process boundary is
+elided); the slow soak spawns three real serving subprocesses and
+kill -9s one mid-stream.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.models import TransformerLM
+from tensorframes_tpu.obs import metrics as obs_metrics
+from tensorframes_tpu.serve import EngineUnhealthyError, GenerationEngine
+from tensorframes_tpu.serve.membership import (
+    Autoscaler,
+    MemberAgent,
+    MemberRegistry,
+    RemoteEngine,
+    connect_fleet,
+    load_params,
+    rolling_restart,
+    rolling_weight_swap,
+    save_params,
+)
+from tensorframes_tpu.utils import chaos
+from tensorframes_tpu.utils.failures import (
+    StaleLeaseError,
+    TenantThrottledError,
+)
+
+pytestmark = pytest.mark.elastic
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM.init(0, VOCAB, d_model=16, n_heads=4, max_len=64)
+
+
+def _solo(lm, prompt, n, **kw):
+    return lm.generate(np.asarray([prompt], np.int32), n, **kw)[
+        0, len(prompt):
+    ]
+
+
+def _wait_for(pred, timeout=20.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def _counter_value(name, **labels):
+    try:
+        return obs_metrics.registry().get(name).value(**labels)
+    except KeyError:
+        return 0.0
+
+
+def _engine(lm, name="m"):
+    return GenerationEngine(
+        lm, max_slots=4, page_size=4, num_pages=48, max_seq_len=64,
+        name=name,
+    )
+
+
+def _http(addr, method, path, body=None):
+    """One raw HTTP exchange against a member's ingress; returns
+    ``(status_code, parsed_body)``."""
+    host, _, port = addr.rpartition(":")
+    payload = b"" if body is None else json.dumps(body).encode()
+    with socket.create_connection((host, int(port)), timeout=10) as c:
+        c.sendall(
+            (
+                f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n"
+            ).encode() + payload
+        )
+        buf = b""
+        while True:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    head, _, raw = buf.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    try:
+        parsed = json.loads(raw.decode())
+    except ValueError:
+        parsed = {}
+    return status, parsed
+
+
+class _Member:
+    """One in-process fleet member: engine + registry + agent (real
+    ScoringServer socket, real lease files)."""
+
+    def __init__(self, lm, reg_dir, name, ttl_s=5.0):
+        self.engine = _engine(lm, name)
+        self.engine.start()
+        self.registry = MemberRegistry(
+            reg_dir, worker_id=f"proc-{name}", ttl_s=ttl_s
+        )
+        self.agent = MemberAgent(self.engine, self.registry, name)
+        self.host, self.port = self.agent.start()
+        self.addr = f"{self.host}:{self.port}"
+
+
+@pytest.fixture
+def trio(lm, tmp_path):
+    """Three members + a connected router, torn down afterwards."""
+    members = [
+        _Member(lm, str(tmp_path), f"m{i}", ttl_s=5.0) for i in range(3)
+    ]
+    fleet = connect_fleet(
+        str(tmp_path), worker_id="router", ttl_s=5.0,
+        sync_interval_s=0.05, watchdog_interval_s=0.05,
+    )
+    fleet.start()
+    _wait_for(
+        lambda: len(fleet.replica_names) == 3, what="3 members in roster"
+    )
+    yield members, fleet
+    fleet.stop()
+    fleet.registry.stop(unlink_held=False)
+    for m in members:
+        m.agent.shutdown(timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpoint:
+    def test_params_round_trip_bytes_and_structure(self, lm, tmp_path):
+        path = save_params(str(tmp_path / "w.npz"), lm)
+        back = load_params(path)
+        assert isinstance(back["n_heads"], int)
+        assert back["n_heads"] == lm.params["n_heads"]
+        assert isinstance(back["blocks"], list)
+        assert len(back["blocks"]) == len(lm.params["blocks"])
+        import jax
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(lm.params),
+            jax.tree_util.tree_leaves(back),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_swap_rejects_mismatched_checkpoint(self, lm, tmp_path):
+        other = TransformerLM.init(
+            1, VOCAB, d_model=32, n_heads=4, max_len=64
+        )
+        path = save_params(str(tmp_path / "bad.npz"), other)
+        eng = _engine(lm)
+        try:
+            with pytest.raises(ValueError):
+                eng.swap_weights(load_params(path))
+        finally:
+            eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# the registry: leases, fencing, zombie rejection
+# ---------------------------------------------------------------------------
+
+
+class TestMemberRegistry:
+    def test_register_and_members_view(self, tmp_path):
+        reg = MemberRegistry(str(tmp_path), worker_id="w0", ttl_s=5.0)
+        try:
+            epoch = reg.register("a", {"url": "h:1", "state": "ready"})
+            assert epoch == 0
+            views = reg.members()
+            assert [v.key for v in views] == ["a"]
+            assert views[0].meta["url"] == "h:1"
+            assert not views[0].expired and not views[0].terminal
+        finally:
+            reg.stop()
+
+    def test_fence_is_exactly_once_and_counted(self, tmp_path):
+        reg = MemberRegistry(str(tmp_path), worker_id="w0", ttl_s=0.2)
+        r1 = MemberRegistry(str(tmp_path), worker_id="router1", ttl_s=0.2)
+        r2 = MemberRegistry(str(tmp_path), worker_id="router2", ttl_s=0.2)
+        try:
+            reg.register("a", {"url": "h:1"})
+            reg.stop(unlink_held=False)  # heartbeat dies; lease lapses
+            _wait_for(
+                lambda: r1.members()[0].expired, what="lease expiry"
+            )
+            before = _counter_value("fleet.member_fences_total")
+            got = [r1.fence("a"), r2.fence("a")]
+            assert sorted(x is None for x in got) == [False, True]
+            assert (
+                _counter_value("fleet.member_fences_total") - before == 1.0
+            )
+            view = r1.members()[0]
+            assert view.terminal and view.state == "fenced"
+        finally:
+            r1.stop()
+            r2.stop()
+
+    def test_zombie_publish_is_rejected_after_fence(self, tmp_path):
+        member = MemberRegistry(str(tmp_path), worker_id="w0", ttl_s=60.0)
+        router = MemberRegistry(str(tmp_path), worker_id="r0", ttl_s=60.0)
+        try:
+            member.register("a", {"url": "h:1", "state": "ready"})
+            assert router.steal("a", state="fenced") is not None
+            with pytest.raises(StaleLeaseError):
+                member.publish_state("a", state="ready")
+        finally:
+            member.stop(unlink_held=False)
+            router.stop()
+
+    def test_reregister_after_tombstone_bumps_epoch(self, tmp_path):
+        member = MemberRegistry(str(tmp_path), worker_id="w0", ttl_s=60.0)
+        router = MemberRegistry(str(tmp_path), worker_id="r0", ttl_s=60.0)
+        fresh = MemberRegistry(str(tmp_path), worker_id="w0b", ttl_s=60.0)
+        try:
+            e0 = member.register("a", {"url": "h:1"})
+            router.steal("a", state="fenced")
+            e1 = fresh.register("a", {"url": "h:2"})
+            assert e1 > e0
+            # the ORIGINAL incarnation stays fenced at its old epoch
+            with pytest.raises(StaleLeaseError):
+                member.publish_state("a", state="ready")
+        finally:
+            member.stop(unlink_held=False)
+            fresh.stop(unlink_held=False)
+            router.stop()
+
+    def test_resign_is_terminal_without_fence_metric(self, tmp_path):
+        reg = MemberRegistry(str(tmp_path), worker_id="w0", ttl_s=60.0)
+        router = MemberRegistry(str(tmp_path), worker_id="r0", ttl_s=60.0)
+        try:
+            reg.register("a", {"url": "h:1"})
+            before = _counter_value("fleet.member_fences_total")
+            reg.resign("a")
+            view = router.members()[0]
+            assert view.terminal and view.state == "resigned"
+            assert _counter_value("fleet.member_fences_total") == before
+        finally:
+            reg.stop(unlink_held=False)
+            router.stop()
+
+    def test_heartbeat_chaos_latency_is_the_presumed_death_drill(
+        self, tmp_path
+    ):
+        """``latency`` on ``fleet.member_heartbeat`` past the TTL stalls
+        the sweep until the lease has lapsed — any router may then
+        fence, and the stalled member discovers the loss when its sweep
+        finally lands (``on_lost``)."""
+        lost = []
+        with chaos.scoped(
+            "seed=1;fleet.member_heartbeat=latency:ms=600"
+        ):
+            member = MemberRegistry(
+                str(tmp_path), worker_id="w0", ttl_s=0.25,
+                heartbeat_s=0.05,
+            )
+            router = MemberRegistry(
+                str(tmp_path), worker_id="r0", ttl_s=0.25
+            )
+            try:
+                member.on_lost = lambda key, epoch, cur: lost.append(key)
+                member.register("a", {"url": "h:1"})
+                _wait_for(
+                    lambda: router.members()[0].expired,
+                    what="stalled heartbeat to lapse the lease",
+                )
+                assert router.fence("a") is not None
+                _wait_for(
+                    lambda: lost == ["a"],
+                    what="member discovering the stolen lease",
+                )
+            finally:
+                member.stop(unlink_held=False)
+                router.stop()
+
+
+# ---------------------------------------------------------------------------
+# the remote-engine adapter (unit level)
+# ---------------------------------------------------------------------------
+
+
+class TestRemoteEngine:
+    def test_refusal_kinds_reraise_the_router_exceptions(self):
+        eng = RemoteEngine("x", "127.0.0.1:1")
+        cases = [
+            (503, {"kind": "QueueFullError", "error": "full"}, None),
+            (503, {"kind": "EngineUnhealthyError", "error": "sick"}, None),
+            (503, {"kind": "Draining", "error": "draining"}, None),
+            (400, {"kind": "ValueError", "error": "bad"}, None),
+            (504, {"kind": "DeadlineExceededError", "error": "late"}, None),
+        ]
+        from tensorframes_tpu.serve.membership import (  # noqa: F401
+            QueueFullError,
+        )
+        from tensorframes_tpu.utils.failures import DeadlineExceededError
+
+        expect = [
+            QueueFullError, EngineUnhealthyError, EngineUnhealthyError,
+            ValueError, DeadlineExceededError,
+        ]
+        for (status, body, _), exc in zip(cases, expect):
+            with pytest.raises(exc):
+                eng._raise_refusal(status, json.dumps(body).encode())
+
+    def test_tenant_throttle_reconstructs_retry_fields(self):
+        eng = RemoteEngine("x", "127.0.0.1:1")
+        body = {
+            "kind": "TenantThrottledError", "error": "over quota",
+            "retry_after": 2.5, "reason": "rate", "tenant": "t9",
+        }
+        with pytest.raises(TenantThrottledError) as ei:
+            eng._raise_refusal(429, json.dumps(body).encode())
+        assert ei.value.retry_after == 2.5
+        assert ei.value.reason == "rate"
+        assert ei.value.tenant == "t9"
+
+    def test_unreachable_member_reads_unhealthy(self):
+        with socket.socket() as s:  # a port nothing listens on
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        eng = RemoteEngine("x", f"127.0.0.1:{port}")
+        h = eng.health()
+        assert h["healthy"] is False and h["reachable"] is False
+        assert not eng.healthy
+        for key in (
+            "queue_depth", "active_slots", "pages_in_use",
+            "pages_capacity", "stepping_thread_alive",
+        ):
+            assert key in h
+
+
+# ---------------------------------------------------------------------------
+# the elastic fleet (in-process topology, real sockets + leases)
+# ---------------------------------------------------------------------------
+
+
+class TestElasticFleet:
+    def test_byte_identity_greedy_and_seeded_over_http(self, lm, trio):
+        members, fleet = trio
+        rng = np.random.default_rng(3)
+        reqs = []
+        for i in range(8):
+            prompt = rng.integers(1, VOCAB, size=4 + i % 3).tolist()
+            kw = (
+                {}
+                if i % 2
+                else {"temperature": 0.7, "seed": 100 + i, "top_p": 0.9}
+            )
+            reqs.append((prompt, 8, kw))
+        handles = [
+            fleet.submit(p, n, session=f"s{i % 4}", **kw)
+            for i, (p, n, kw) in enumerate(reqs)
+        ]
+        for h, (p, n, kw) in zip(handles, reqs):
+            got = np.asarray(h.result(timeout=60))
+            np.testing.assert_array_equal(got, _solo(lm, p, n, **kw))
+
+    def test_readyz_and_ingress_gate_through_drain_admit(self, trio):
+        members, fleet = trio
+        m = members[0]
+        assert _http(m.addr, "GET", "/readyz")[0] == 200
+        status, body = _http(
+            m.addr, "POST", "/admin/lifecycle", {"action": "drain"}
+        )
+        assert status == 200
+        status, body = _http(m.addr, "GET", "/readyz")
+        assert status == 503 and body["state"] == "draining"
+        # liveness is NOT affected: a balancer must not recycle it
+        assert _http(m.addr, "GET", "/healthz")[0] == 200
+        # the ingress sheds new work while in-flight streams finish
+        status, body = _http(
+            m.addr, "POST", "/generate",
+            {"prompt": [1, 2], "max_new_tokens": 2},
+        )
+        assert status == 503 and body["kind"] == "Draining"
+        # the router mirrors the drain from the registry metadata
+        _wait_for(
+            lambda: fleet.replica_state("m0") == "draining",
+            what="router seeing the drain",
+        )
+        status, _ = _http(
+            m.addr, "POST", "/admin/lifecycle", {"action": "admit"}
+        )
+        assert status == 200
+        assert _http(m.addr, "GET", "/readyz")[0] == 200
+        _wait_for(
+            lambda: fleet.replica_state("m0") == "active",
+            what="router re-admitting after probe",
+        )
+
+    def test_member_fault_replays_to_survivor_byte_identical(
+        self, lm, trio
+    ):
+        members, fleet = trio
+        prompt, n = [3, 1, 4, 1], 16
+        want = _solo(lm, prompt, n, temperature=0.6, seed=11)
+        with chaos.scoped("seed=1;serve.decode_step=latency:ms=15"):
+            h = fleet.submit(
+                prompt, n, temperature=0.6, seed=11, session="die"
+            )
+            _wait_for(lambda: len(h._tokens) >= 2, what="stream underway")
+            victim = fleet._sessions["die"][0].name
+            owner = next(m for m in members if m.agent.name == victim)
+            owner.engine.inject_fault(RuntimeError("member blew up"))
+        got = np.asarray(h.result(timeout=60))
+        np.testing.assert_array_equal(got, want)
+
+    def test_dead_member_is_fenced_once_and_leaves_roster(
+        self, lm, trio
+    ):
+        members, fleet = trio
+        m0 = members[0]
+        before = _counter_value("fleet.member_fences_total")
+        # the process "dies": ingress gone, heartbeat gone
+        m0.agent.server.stop()
+        m0.registry.stop(unlink_held=False)
+        _wait_for(
+            lambda: "m0" not in fleet.replica_names,
+            timeout=30,
+            what="dead member leaving the roster",
+        )
+        assert _counter_value("fleet.member_fences_total") - before == 1.0
+        view = next(
+            v for v in fleet.registry.members() if v.key == "m0"
+        )
+        assert view.state == "fenced"
+        # the fenced member's own late write is rejected (zombie)
+        with pytest.raises(StaleLeaseError):
+            m0.registry.publish_state("m0", state="ready")
+        # survivors still serve, byte-identically
+        got = np.asarray(fleet.submit([5, 6], 6).result(timeout=60))
+        np.testing.assert_array_equal(got, _solo(lm, [5, 6], 6))
+
+    def test_rolling_restart_zero_failed_requests(self, lm, trio):
+        members, fleet = trio
+        rng = np.random.default_rng(5)
+        stop = threading.Event()
+        failures, checked = [], [0]
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                prompt = rng.integers(1, VOCAB, size=3).tolist()
+                try:
+                    got = np.asarray(
+                        fleet.submit(prompt, 4).result(timeout=60)
+                    )
+                    np.testing.assert_array_equal(
+                        got, _solo(lm, prompt, 4)
+                    )
+                    checked[0] += 1
+                except Exception as e:  # noqa: BLE001
+                    failures.append(e)
+                i += 1
+                time.sleep(0.02)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        try:
+            out = rolling_restart(fleet, drain_timeout_s=15.0)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert out["outcome"] == "ok"
+        assert sorted(out["restarted"]) == ["m0", "m1", "m2"]
+        assert not failures, failures
+        assert checked[0] > 0
+        assert all(
+            fleet.replica_state(n) == "active" for n in fleet.replica_names
+        )
+
+    def test_rolling_weight_swap_commits_and_stays_byte_identical(
+        self, lm, trio, tmp_path
+    ):
+        members, fleet = trio
+        before = _counter_value("fleet.rollouts_total", outcome="ok")
+        ckpt = save_params(str(tmp_path / "same.npz"), lm)
+        out = rolling_weight_swap(fleet, ckpt, drain_timeout_s=15.0)
+        assert out["outcome"] == "ok"
+        assert (
+            _counter_value("fleet.rollouts_total", outcome="ok") - before
+            == 1.0
+        )
+        got = np.asarray(
+            fleet.submit([7, 8, 9], 8, temperature=0.5, seed=2).result(
+                timeout=60
+            )
+        )
+        np.testing.assert_array_equal(
+            got, _solo(lm, [7, 8, 9], 8, temperature=0.5, seed=2)
+        )
+
+    def test_swap_probe_failure_rolls_back_and_halts(
+        self, lm, trio, tmp_path, monkeypatch
+    ):
+        """A checkpoint that passes load/shape validation on every
+        member but fails the probe on one: the rollout rolls EVERY
+        swapped member back (mixed weights would break failover
+        byte-identity) and halts."""
+        members, fleet = trio
+        other = TransformerLM.init(
+            9, VOCAB, d_model=16, n_heads=4, max_len=64
+        )
+        ckpt = save_params(str(tmp_path / "new.npz"), other)
+        order = list(fleet.replica_names)
+        real_probe = fleet.probe_replica
+        calls = []
+
+        def failing_probe(name):
+            calls.append(name)
+            if len(calls) == 2:  # second member's probe "fails"
+                return False
+            return real_probe(name)
+
+        monkeypatch.setattr(fleet, "probe_replica", failing_probe)
+        before = _counter_value(
+            "fleet.rollouts_total", outcome="rolled_back"
+        )
+        out = rolling_weight_swap(fleet, ckpt, drain_timeout_s=15.0)
+        assert out["outcome"] == "rolled_back"
+        assert out["failed"] == order[1]
+        assert (
+            _counter_value("fleet.rollouts_total", outcome="rolled_back")
+            - before
+            == 1.0
+        )
+        _wait_for(
+            lambda: all(
+                fleet.replica_state(n) == "active"
+                for n in fleet.replica_names
+            ),
+            what="all members re-admitted on old weights",
+        )
+        # the OLD weights serve on EVERY member — including the ones
+        # re-admitted BEFORE the failure (their rollback stash must
+        # survive the per-member admit) — byte-identical to solo
+        ref = _solo(lm, [2, 3], 8)
+        for name in fleet.replica_names:
+            rep = fleet._replica(name)
+            got = np.asarray(
+                rep.engine.submit([2, 3], max_new_tokens=8).result(
+                    timeout=60
+                )
+            )
+            np.testing.assert_array_equal(
+                got, ref, err_msg=f"member {name} not on old weights"
+            )
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+
+
+class _FakeFleet:
+    def __init__(self, n):
+        self.replica_names = [f"m{i}" for i in range(n)]
+        self._tick_hooks = []
+
+
+class TestAutoscaler:
+    def _scaler(self, n=2, **kw):
+        fleet = _FakeFleet(n)
+        ups, downs = [], []
+        sig = {"queue_depth": 0.0, "pages_frac": 0.0, "itl_p99_s": 0.0,
+               "members": float(n)}
+        kw.setdefault("cooldown_s", 10.0)
+        sc = Autoscaler(
+            fleet,
+            scale_up=lambda: ups.append(1),
+            scale_down=lambda: downs.append(1),
+            min_members=1, max_members=4,
+            queue_high=8, pages_frac_high=0.85, itl_p99_high_s=1.0,
+            signals_fn=lambda: sig,
+            **kw,
+        )
+        return sc, sig, ups, downs
+
+    def test_scale_up_on_any_pressure_signal(self):
+        for key, value in (
+            ("queue_depth", 20.0),
+            ("pages_frac", 0.95),
+            ("itl_p99_s", 3.0),
+        ):
+            sc, sig, ups, downs = self._scaler()
+            sig[key] = value
+            assert sc.evaluate(now=100.0) == "up"
+            assert ups and not downs
+
+    def test_scale_down_only_when_everything_is_quiet(self):
+        sc, sig, ups, downs = self._scaler()
+        assert sc.evaluate(now=100.0) == "down"
+        assert downs and not ups
+        sc, sig, ups, downs = self._scaler()
+        sig["queue_depth"] = 3.0  # above queue_low: not quiet
+        assert sc.evaluate(now=100.0) is None
+
+    def test_bounds_and_cooldown(self):
+        sc, sig, ups, downs = self._scaler(n=4)
+        sig["queue_depth"] = 50.0
+        assert sc.evaluate(now=100.0) is None  # at max_members
+        sc, sig, ups, downs = self._scaler(n=1)
+        assert sc.evaluate(now=100.0) is None  # at min_members
+        sc, sig, ups, downs = self._scaler()
+        sig["queue_depth"] = 50.0
+        before = _counter_value(
+            "fleet.scale_decisions_total", direction="up"
+        )
+        assert sc.evaluate(now=100.0) == "up"
+        assert sc.evaluate(now=105.0) is None  # inside cooldown
+        assert sc.evaluate(now=111.0) == "up"  # past it
+        assert (
+            _counter_value("fleet.scale_decisions_total", direction="up")
+            - before
+            == 2.0
+        )
+
+
+# ---------------------------------------------------------------------------
+# docs drift: the ingress surface must stay documented
+# ---------------------------------------------------------------------------
+
+
+class TestEndpointDocsDrift:
+    def test_every_route_is_documented(self):
+        """Every route the ingress answers must appear in the docs —
+        in particular the liveness/readiness SPLIT (`/healthz` vs
+        `/readyz`) and the lifecycle actuator, which operators and
+        balancer configs are built against."""
+        from pathlib import Path
+
+        from tensorframes_tpu.interop.serving import ScoringServer
+
+        docs_root = Path(__file__).resolve().parent.parent / "docs"
+        corpus = "".join(
+            p.read_text()
+            for p in (
+                docs_root / "observability.md",
+                docs_root / "serving_llm.md",
+                docs_root / "fault_tolerance.md",
+            )
+        )
+        missing = [r for r in ScoringServer._ROUTES if r not in corpus]
+        assert not missing, (
+            f"ingress routes missing from the docs: {missing}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM: the graceful exit (real subprocess)
+# ---------------------------------------------------------------------------
+
+
+_MEMBER_SCRIPT = r"""
+import json, os, sys, time
+from tensorframes_tpu.models import TransformerLM
+from tensorframes_tpu.serve import GenerationEngine
+from tensorframes_tpu.serve.membership import MemberAgent, MemberRegistry
+
+reg_dir, name, ttl = sys.argv[1], sys.argv[2], float(sys.argv[3])
+lm = TransformerLM.init(0, 32, d_model=16, n_heads=4, max_len=64)
+eng = GenerationEngine(
+    lm, max_slots=4, page_size=4, num_pages=48, max_seq_len=64, name=name
+)
+eng.start()
+agent = MemberAgent(
+    eng, MemberRegistry(reg_dir, worker_id=f"proc-{name}", ttl_s=ttl), name
+)
+agent.start()
+agent.install_sigterm()
+report = sys.argv[4] if len(sys.argv) > 4 else ""
+while True:
+    time.sleep(0.05)
+    if report and agent.state == "fenced":
+        out = {"fenced": True}
+        try:
+            agent.registry.publish_state(name, state="ready")
+            out["zombie_rejected"] = False
+        except Exception as e:
+            out["zombie_rejected"] = type(e).__name__ == "StaleLeaseError"
+        with open(report + ".tmp", "w") as f:
+            json.dump(out, f)
+        os.rename(report + ".tmp", report)
+        report = ""
+"""
+
+
+def _spawn_member(reg_dir, name, ttl, report="", extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(extra_env or {})
+    args = [sys.executable, "-c", _MEMBER_SCRIPT, reg_dir, name, str(ttl)]
+    if report:
+        args.append(report)
+    return subprocess.Popen(
+        args, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+class TestSigtermDrain:
+    def test_sigterm_drains_resigns_and_exports(self, tmp_path):
+        reg_dir = str(tmp_path / "reg")
+        tele_dir = str(tmp_path / "tele")
+        os.makedirs(tele_dir)
+        proc = _spawn_member(
+            reg_dir, "w0", 30.0,
+            extra_env={"TFT_TELEMETRY_DIR": tele_dir},
+        )
+        router = None
+        try:
+            router = MemberRegistry(
+                reg_dir, worker_id="router", ttl_s=30.0
+            )
+            _wait_for(
+                lambda: any(
+                    not v.terminal for v in router.members()
+                ),
+                timeout=60,
+                what="member registration",
+            )
+            url = router.members()[0].meta["url"]
+            status, body = _http(
+                url, "POST", "/generate",
+                {"prompt": [1, 2, 3], "max_new_tokens": 4},
+            )
+            assert status == 200 and len(body["tokens"]) == 4
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=60) == 0
+            # the lease is resigned or gone — never left to lapse
+            views = router.members()
+            assert all(v.terminal for v in views), [
+                (v.key, v.state) for v in views
+            ]
+            # the final telemetry snapshot made it out
+            assert any(
+                f.endswith(".json") for f in os.listdir(tele_dir)
+            ), os.listdir(tele_dir)
+        finally:
+            if router is not None:
+                router.stop()
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance soak: three real serving processes, one router
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestMultiProcessSoak:
+    def test_kill_wedge_swap_soak(self, lm, tmp_path):
+        """The ISSUE's acceptance drill: three serving subprocesses
+        behind one router; 16 staggered streams (greedy and seeded);
+        a rolling weight swap (identical weights) mid-traffic with
+        zero failed requests; one member kill -9'd mid-stream; one
+        member wedged past its lease TTL by chaos latency on its
+        heartbeat. Every stream byte-identical to solo; the victims
+        fenced exactly once each; the wedged zombie's late registry
+        write epoch-rejected.
+
+        Timeline: the swap races phase-1 traffic while all three
+        leases are fresh (the wedged member's longer TTL keeps it
+        registered through the swap); the kill -9 lands mid-phase-2;
+        the wedge fences at its TTL and the zombie discovers it when
+        the stalled sweep finally returns."""
+        reg_dir = str(tmp_path / "reg")
+        decode_lag = "serve.decode_step=latency:ms=12"
+        ttl = 8.0
+        procs = {
+            # m0 survives; m1 gets kill -9; m2 wedges (its heartbeat
+            # stalls 20s — longer than the TTL — on its first sweep, so
+            # it is fenced while wedged and learns on the late sweep)
+            "m0": _spawn_member(
+                reg_dir, "m0", ttl,
+                extra_env={"TFT_CHAOS": f"seed=1;{decode_lag}"},
+            ),
+            "m1": _spawn_member(
+                reg_dir, "m1", ttl,
+                extra_env={"TFT_CHAOS": f"seed=2;{decode_lag}"},
+            ),
+            # m2 gets a longer TTL (20s) so the rolling swap finishes
+            # before its wedge lapses the lease; the 45s stall is still
+            # far past it, so the presumed-death fence fires while the
+            # member is genuinely unresponsive
+            "m2": _spawn_member(
+                reg_dir, "m2", 20.0,
+                report=str(tmp_path / "m2.report.json"),
+                extra_env={
+                    "TFT_CHAOS": (
+                        f"seed=3;{decode_lag};"
+                        "fleet.member_heartbeat=latency:p=1:ms=45000"
+                    )
+                },
+            ),
+        }
+        fleet = None
+        try:
+            fleet = connect_fleet(
+                reg_dir, worker_id="router", ttl_s=ttl,
+                sync_interval_s=0.1, watchdog_interval_s=0.05,
+                failover_timeout_s=120.0,
+            )
+            fleet.start()
+            _wait_for(
+                lambda: len(fleet.replica_names) == 3,
+                timeout=90,
+                what="3 subprocess members joining",
+            )
+            fences_before = _counter_value("fleet.member_fences_total")
+
+            rng = np.random.default_rng(17)
+            reqs = []
+            for i in range(16):
+                prompt = rng.integers(1, VOCAB, size=3 + i % 4).tolist()
+                kw = (
+                    {}
+                    if i % 3 == 0
+                    else {"temperature": 0.8, "seed": 40 + i}
+                )
+                reqs.append((prompt, 12, kw))
+            want = [_solo(lm, p, n, **kw) for p, n, kw in reqs]
+
+            handles = []
+            swap_result = {}
+
+            def run_swap():
+                ckpt = save_params(str(tmp_path / "same.npz"), lm)
+                swap_result.update(
+                    rolling_weight_swap(fleet, ckpt, drain_timeout_s=20.0)
+                )
+
+            # phase 1: staggered streams with the rolling swap racing
+            # them (all three members healthy: m2's lease stays fresh
+            # until its stalled sweep lapses it at ~TTL)
+            swapper = None
+            for i in range(8):
+                p, n, kw = reqs[i]
+                handles.append(
+                    fleet.submit(p, n, session=f"s{i % 5}", **kw)
+                )
+                time.sleep(0.12)
+                if i == 3:
+                    swapper = threading.Thread(
+                        target=run_swap, daemon=True
+                    )
+                    swapper.start()
+            swapper.join(timeout=180)
+            assert not swapper.is_alive(), "rolling swap never finished"
+            # zero failed requests through the swap, and it committed
+            assert swap_result.get("outcome") == "ok", swap_result
+
+            # phase 2: more staggered streams; kill -9 one member with
+            # its streams in flight
+            for i in range(8, 16):
+                p, n, kw = reqs[i]
+                handles.append(
+                    fleet.submit(p, n, session=f"s{i % 5}", **kw)
+                )
+                time.sleep(0.12)
+                if i == 9:
+                    procs["m1"].kill()  # SIGKILL mid-stream
+
+            for h, w in zip(handles, want):
+                got = np.asarray(h.result(timeout=180))
+                np.testing.assert_array_equal(got, np.asarray(w))
+
+            # both victims fenced, each exactly once
+            _wait_for(
+                lambda: _counter_value("fleet.member_fences_total")
+                - fences_before
+                >= 2.0,
+                timeout=90,
+                what="both victims fenced",
+            )
+            _wait_for(
+                lambda: set(fleet.replica_names) == {"m0"},
+                timeout=90,
+                what="victims leaving the roster",
+            )
+            assert (
+                _counter_value("fleet.member_fences_total")
+                - fences_before
+                == 2.0
+            )
+            states = {
+                v.key: v.state for v in fleet.registry.members()
+            }
+            assert states["m1"] == "fenced"
+            assert states["m2"] == "fenced"
+
+            # the wedged zombie discovered the fence and its late
+            # write was epoch-rejected
+            report_path = str(tmp_path / "m2.report.json")
+            _wait_for(
+                lambda: os.path.exists(report_path),
+                timeout=90,
+                what="the wedged member's zombie report",
+            )
+            with open(report_path) as f:
+                report = json.load(f)
+            assert report == {"fenced": True, "zombie_rejected": True}
+
+            # the survivor still serves byte-identically
+            got = np.asarray(
+                fleet.submit([9, 9, 2], 6, temperature=0.4, seed=5)
+                .result(timeout=120)
+            )
+            np.testing.assert_array_equal(
+                got, _solo(lm, [9, 9, 2], 6, temperature=0.4, seed=5)
+            )
+        finally:
+            if fleet is not None:
+                fleet.stop()
+                fleet.registry.stop(unlink_held=False)
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait(timeout=30)

@@ -585,7 +585,29 @@ class TestServingEndToEnd:
                 np.testing.assert_allclose(
                     out.column("y_obs_e2e").to_numpy(), xs * 2.0 + 1.0
                 )
-                text = _http_get(addr, "/metrics")
+                # the latency observation lands in the handler's
+                # finally, AFTER the client has its response bytes — on
+                # a loaded one-core box the second handler thread can
+                # still be parked when an immediate scrape is served,
+                # so re-scrape until both observations landed
+                import time as _t
+
+                deadline = _t.monotonic() + 10.0
+                while True:
+                    text = _http_get(addr, "/metrics")
+                    landed = [
+                        ln
+                        for ln in text.splitlines()
+                        if ln.startswith(
+                            "tft_serving_request_seconds_count "
+                        )
+                    ]
+                    if (
+                        landed
+                        and float(landed[0].rsplit(" ", 1)[1]) >= 2
+                    ) or _t.monotonic() > deadline:
+                        break
+                    _t.sleep(0.05)
                 assert _http_get(addr, "/nope").startswith(
                     "HTTP/1.1 404"
                 )

@@ -913,6 +913,91 @@ class TestBenchCheck:
         )
         assert mod.check() == 0
 
+    def test_per_metric_tolerance_overrides_global(
+        self, tmp_path, monkeypatch
+    ):
+        """A `tolerances[<metric>]` entry widens (or narrows) just that
+        metric's band — the fix for the false alarm where map_rows'
+        machine-to-machine variance is wider than the global band that
+        fits the decode bench."""
+        mod = self._load_module()
+        target = self._gate(tmp_path, mod, 1000.0)
+        base = json.loads(target.read_text())
+        base["bench_gate"]["tolerances"] = {
+            "map_rows_journaled_rows_per_sec": 45.0
+        }
+        target.write_text(json.dumps(base))
+        monkeypatch.setattr(
+            mod, "_run_bench",
+            lambda config, env: {
+                "metric": "map_rows_journaled_rows_per_sec",
+                "value": 600.0,  # -40%: fails at 20% global, ok at 45%
+            },
+        )
+        assert mod.check() == 0
+        # a metric WITHOUT an entry keeps the global band
+        base["bench_gate"]["tolerances"] = {"some_other_metric": 45.0}
+        target.write_text(json.dumps(base))
+        assert mod.check() == 1
+
+    def test_env_override_beats_per_metric_tolerance(
+        self, tmp_path, monkeypatch
+    ):
+        mod = self._load_module()
+        target = self._gate(tmp_path, mod, 1000.0)
+        base = json.loads(target.read_text())
+        base["bench_gate"]["tolerances"] = {
+            "map_rows_journaled_rows_per_sec": 45.0
+        }
+        target.write_text(json.dumps(base))
+        monkeypatch.setenv("TFT_BENCH_TOLERANCE_PCT", "10")
+        monkeypatch.setattr(
+            mod, "_run_bench",
+            lambda config, env: {
+                "metric": "map_rows_journaled_rows_per_sec",
+                "value": 700.0,  # -30%: inside 45%, outside env's 10%
+            },
+        )
+        assert mod.check() == 1
+
+    def test_update_preserves_per_metric_tolerances(
+        self, tmp_path, monkeypatch
+    ):
+        """--update re-measures values but must carry the `tolerances`
+        block forward: the bands encode measured host variance, not the
+        baseline numbers being replaced."""
+        mod = self._load_module()
+        target = self._gate(tmp_path, mod, 1000.0)
+        base = json.loads(target.read_text())
+        base["bench_gate"]["tolerances"] = {
+            "map_rows_journaled_rows_per_sec": 45.0
+        }
+        target.write_text(json.dumps(base))
+        results = {
+            "map_rows": {
+                "metric": "map_rows_journaled_rows_per_sec",
+                "value": 1234.5,
+                "unit": "rows/s",
+            },
+            "decode_serve": {
+                "metric": "decode_serve_tokens_per_sec",
+                "value": 99.0,
+                "unit": "tok/s",
+            },
+        }
+        monkeypatch.setattr(
+            mod, "_run_bench", lambda config, env: results[config]
+        )
+        assert mod.update() == 0
+        rewritten = json.loads(target.read_text())["bench_gate"]
+        assert rewritten["tolerances"] == {
+            "map_rows_journaled_rows_per_sec": 45.0
+        }
+        assert (
+            rewritten["metrics"]["map_rows_journaled_rows_per_sec"]["value"]
+            == 1234.5
+        )
+
     def test_missing_gate_block_is_a_setup_error(self, tmp_path):
         mod = self._load_module()
         target = tmp_path / "BASELINE.json"

@@ -1059,6 +1059,7 @@ class TestDocsDrift:
         import tensorframes_tpu.obs.flight  # noqa: F401
         import tensorframes_tpu.serve.engine  # noqa: F401
         import tensorframes_tpu.serve.fleet  # noqa: F401
+        import tensorframes_tpu.serve.membership  # noqa: F401
         import tensorframes_tpu.tune  # noqa: F401
         import tensorframes_tpu.utils.chaos  # noqa: F401
         import tensorframes_tpu.utils.failures  # noqa: F401
