@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-elastic test-fleet test-multihost test-obs test-obsfleet test-plan test-spec test-tenancy test-tp test-tune verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-elastic test-fleet test-ha test-multihost test-obs test-obsfleet test-plan test-spec test-tenancy test-tp test-tune soak verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -126,6 +126,20 @@ test-tp:
 # here too
 test-elastic:
 	$(PY) -m pytest tests/ -q -m elastic
+
+# the router high-availability suite (serve/router_ha.py: request WAL,
+# resumable streams, fenced standby takeover, lease clock edges, local
+# subprocess provisioner); the 2-router + 3-member kill -9 takeover
+# acceptance soak is marked slow and runs here too
+test-ha:
+	$(PY) -m pytest tests/ -q -m ha
+
+# every multi-process fault-tolerance soak in one command: the elastic
+# membership, fleet failover, chaos, and router-HA suites INCLUDING
+# their slow-marked subprocess drills — the pre-release confidence run
+# (budget ~15 min; tier-1 stays the fast gate)
+soak:
+	$(PY) -m pytest tests/ -q -m "elastic or fleet or chaos or ha"
 
 # just the real 2-process distributed suite
 test-multihost:

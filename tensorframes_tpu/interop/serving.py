@@ -102,6 +102,12 @@ _m_latency = _histogram(
 _m_active = _gauge(
     "serving.active_connections", "Connections currently being served"
 )
+_m_stream_resumes = _counter(
+    "serve.stream_resumes_total",
+    "Generate requests served from the router WAL's tracker instead of "
+    "a fresh generation: duplicate request_id dedupe, and client "
+    "reconnects resuming a stream with from=<offset>",
+)
 
 
 def _adaptive_retry_after(engine) -> str:
@@ -183,6 +189,7 @@ class ScoringServer:
         engine=None,
         readiness=None,
         lifecycle=None,
+        router_epoch_fn=None,
     ):
         if fetches is None and engine is None:
             raise ValueError(
@@ -215,6 +222,15 @@ class ScoringServer:
         #: gate traffic WITHOUT touching /healthz's liveness meaning.
         #: ``None`` → readiness mirrors liveness.
         self._readiness = readiness
+        #: member-side half of zombie-router fencing: ``() ->
+        #: Optional[int]`` reading the router election lease's CURRENT
+        #: epoch (serve/router_ha.py's ``router_epoch_from``). When set,
+        #: a ``POST /generate`` whose ``x-router-epoch`` header is below
+        #: it came from a router that already lost the lease — answered
+        #: ``409 Conflict`` (kind ``StaleRouterEpochError``) instead of
+        #: decoding tokens the new active is re-generating. ``None`` (or
+        #: no header) → no fencing.
+        self._router_epoch_fn = router_epoch_fn
         #: lifecycle actuator for ``POST /admin/lifecycle``:
         #: ``(action, spec) -> payload dict`` (drain / admit / restart /
         #: swap / rollback — serve/membership.py wires the member's
@@ -733,6 +749,8 @@ class ScoringServer:
           with sharded-pool capacity, per-shard pages in use, and
           per-shard KV bytes, so operators see capacity scaling with
           the mesh at a glance (ISSUE 14);
+        - ``router``: router-HA election + WAL state when a
+          ``RouterHA`` is attached (``serve/router_ha.py``);
         - ``trace_sink``: whether a JSONL span sink is attached.
 
         Always 200; rendering reads only lock-light engine counters
@@ -815,6 +833,11 @@ class ScoringServer:
             # tokens/s + est FLOPs from the cost ledger, throttles —
             # read-side aggregation only (serve/tenancy.py)
             "tenants": self._tenants_view(),
+            # router HA (serve/router_ha.py; None without an attached
+            # RouterHA): election state (active/fenced, epoch, TTL) and
+            # the WAL tracker's depth — the first place to look after a
+            # takeover drill
+            "router": self._router_view(),
         }
         return "200 OK", json.dumps(payload, default=str).encode(
             "utf-8"
@@ -828,6 +851,19 @@ class ScoringServer:
             from ..serve import tenancy as _tenancy
 
             return _tenancy.statusz_view(self._engine)
+        except Exception as e:  # pragma: no cover - defensive
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _router_view(self):
+        """The router-HA ``/statusz`` block (None when this server's
+        engine has no :class:`~tensorframes_tpu.serve.router_ha.RouterHA`
+        attached); exceptions degrade to an ``"error"`` stub — the
+        status page always renders."""
+        ha = getattr(self._engine, "router_ha", None)
+        if ha is None:
+            return None
+        try:
+            return ha.statusz_view()
         except Exception as e:  # pragma: no cover - defensive
             return {"error": f"{type(e).__name__}: {e}"}
 
@@ -1037,7 +1073,19 @@ class ScoringServer:
         (lease lost — a zombie must not take traffic), new requests
         answer 503 immediately — in-flight streams keep decoding;
         probes during ``"probing"``/``"swapping"`` deliberately pass
-        (the rollout's validation traffic must reach the engine)."""
+        (the rollout's validation traffic must reach the engine).
+
+        **Durable requests** (``Config.router_wal`` +
+        ``serve/router_ha.py``): a client-supplied ``"request_id"`` is
+        echoed on every response and, with the WAL attached, makes the
+        request idempotent — a duplicate id serves the journaled entry
+        instead of generating again, and a reconnect with
+        ``"request_id"`` + ``"from": <tokens already received>``
+        replays the missed prefix then follows the live tail. A
+        placement whose ``x-router-epoch`` header is below the router
+        election lease's epoch answers ``409 Conflict``
+        (``StaleRouterEpochError``) — zombie-router fencing; a standby
+        router answers 503 (kind ``RouterStandby``)."""
         import json
 
         t0 = time.perf_counter()
@@ -1045,6 +1093,11 @@ class ScoringServer:
             (headers or {}).get("traceparent")
         )
         ctx = root.child() if root is not None else _new_trace()
+        # the client-supplied idempotent request id (filled in during
+        # spec parse); when present, EVERY response echoes it verbatim
+        # — it names the request across retries/reconnects, so the
+        # engine's internal handle id stays internal
+        rid_box: Dict[str, Optional[str]] = {"rid": None}
 
         def reply(
             status: str,
@@ -1053,6 +1106,8 @@ class ScoringServer:
             handle=None,
         ) -> Tuple[str, bytes, Dict[str, str]]:
             total = time.perf_counter() - t0
+            if rid_box["rid"] is not None:
+                payload["request_id"] = rid_box["rid"]
             payload["trace_id"] = ctx.trace_id
             if handle is not None or status.startswith("200"):
                 payload["timing"] = self._timing_payload(handle, total)
@@ -1071,6 +1126,54 @@ class ScoringServer:
             return reply(
                 "501 Not Implemented",
                 {"error": "server has no generation engine"},
+            )
+        def echo_rid() -> None:
+            # refusals answered BEFORE the spec parse still echo a
+            # client-supplied request_id (the retry loop keys on it);
+            # best-effort only — a malformed body stays a refusal
+            if rid_box["rid"] is None:
+                try:
+                    _rid = json.loads(
+                        body.decode("utf-8") or "{}"
+                    ).get("request_id")
+                except Exception:
+                    _rid = None
+                if _rid is not None:
+                    rid_box["rid"] = str(_rid)
+
+        # zombie-router fencing (member side): a placement stamped with
+        # an election epoch BELOW the lease's current one came from a
+        # router that already lost the lease — its requests are being
+        # re-generated by the new active, so decoding them here would
+        # double-spend the chip and race the resumed stream
+        stale = self._stale_router_epoch((headers or {}).get(
+            "x-router-epoch"
+        ))
+        if stale is not None:
+            placed, cur = stale
+            echo_rid()
+            return reply(
+                "409 Conflict",
+                {"error": f"placement carries router epoch {placed} but "
+                          f"the election lease is at epoch {cur}: the "
+                          "placing router was superseded (fenced "
+                          "zombie)",
+                 "kind": "StaleRouterEpochError"},
+            )
+        # router standby gate (router side): only the ACTIVE router may
+        # admit — a standby (or a fenced ex-active) answers 503 so
+        # clients re-resolve to the current active instead of parking
+        # work on a router that cannot place it
+        ha = getattr(self._engine, "router_ha", None)
+        if ha is not None and not ha.active:
+            echo_rid()
+            return reply(
+                "503 Service Unavailable",
+                {"error": "this router is standby/fenced (not the "
+                          "active router); retry — takeover completes "
+                          "within the election TTL",
+                 "kind": "RouterStandby"},
+                {"Retry-After": "1"},
             )
         if self._readiness is not None:
             try:
@@ -1128,11 +1231,60 @@ class ScoringServer:
                 # supplied one so duck-typed engines without the kwarg
                 # keep working
                 kwargs["tenant"] = str(tenant)
+            if spec.get("request_id") is not None:
+                rid_box["rid"] = str(spec["request_id"])
+            # stream-resume cursor: how many tokens the client already
+            # has (only meaningful on a reconnect with a request_id the
+            # WAL tracker knows)
+            resume_from = int(spec.get("from", 0) or 0)
+            if resume_from < 0:
+                raise ValueError(f"negative resume offset {resume_from}")
         except (ValueError, KeyError, TypeError) as e:
             return reply(
                 "400 Bad Request",
                 {"error": f"bad request: {type(e).__name__}: {e}"},
             )
+        # durable-request plane (serve/router_ha.py, Config.router_wal):
+        # with a client request_id and an attached WAL, a duplicate id
+        # serves the EXISTING entry (dedupe / reconnect-resume) and a
+        # fresh one is journaled before placement. Gated zero-cost-off:
+        # no request_id, no WAL, or router_wal=False → this whole block
+        # is a couple of attribute reads and the path below is
+        # byte-identical to the pre-HA stack.
+        wal = None
+        wal_entry = None
+        if rid_box["rid"] is not None:
+            wal = getattr(self._engine, "wal", None)
+            if wal is not None:
+                from ..serve.router_ha import enabled as _wal_enabled
+
+                if not _wal_enabled():
+                    wal = None
+        if wal is not None:
+            rid = rid_box["rid"]
+            record = {
+                "prompt": [int(t) for t in prompt],
+                "max_new": max_new,
+                "temperature": kwargs["temperature"],
+                "top_p": kwargs["top_p"],
+                "seed": kwargs["seed"],
+                "eos_id": kwargs.get("eos_id"),
+                "session": kwargs.get("session"),
+                "tenant": kwargs.get("tenant"),
+                "deadline_s": deadline,
+                "trace": ctx.traceparent(),
+            }
+            wal_entry, created = wal.admit(rid, record)
+            if not created:
+                # duplicate submit or reconnect: serve what the tracker
+                # already holds — never generate the same id twice
+                _m_stream_resumes.inc()
+                if stream:
+                    self._stream_entry(
+                        conn, ctx, wal_entry, t0, resume_from
+                    )
+                    return None
+                return self._reply_entry(reply, wal_entry)
         try:
             # the ambient trace around submit is how the trace_id
             # reaches the engine/fleet: the request record and every
@@ -1147,6 +1299,8 @@ class ScoringServer:
             # the fleet router can notice a deadline expiring DURING
             # placement (DeadlineExceededError) — same 504 as a stream
             # that expired mid-generation
+            if wal_entry is not None:
+                wal.forget(rid_box["rid"], e)
             return reply(
                 "504 Gateway Timeout",
                 {"error": str(e), "kind": type(e).__name__},
@@ -1156,13 +1310,23 @@ class ScoringServer:
             # serve/tenancy.py) — the server has capacity, this tenant
             # may not use it: 429, not the all-full 503. Retry-After is
             # the refusing token bucket's refill time, clamped to the
-            # same [1, 30] window the adaptive 503 hint uses.
+            # same [1, 30] window the adaptive 503 hint uses — UNLESS
+            # the refusal was relayed from a member, in which case the
+            # member's own Retry-After header rides the exception
+            # (retry_after_hint) and is echoed verbatim: the member
+            # knows its bucket, the router's would be a guess.
             import math
 
-            retry = str(int(min(30, max(1, math.ceil(e.retry_after)))))
+            if wal_entry is not None:
+                wal.forget(rid_box["rid"], e)
+            hint = getattr(e, "retry_after_hint", None)
+            retry = str(hint) if hint else str(
+                int(min(30, max(1, math.ceil(e.retry_after))))
+            )
             return reply(
                 "429 Too Many Requests",
                 {"error": str(e), "tenant": e.tenant, "reason": e.reason,
+                 "retry_after": e.retry_after,
                  "kind": "TenantThrottledError"},
                 {"Retry-After": retry},
             )
@@ -1170,19 +1334,35 @@ class ScoringServer:
             # overload shedding: the caller can retry, THIS server can't
             # help right now — answer fast instead of parking the
             # connection against a full queue or a dead engine. The
-            # Retry-After adapts to the backlog (depth x p50 ITL).
+            # Retry-After adapts to the backlog (depth x p50 ITL), or is
+            # the member's verbatim hint when the refusal was relayed.
+            if wal_entry is not None:
+                wal.forget(rid_box["rid"], e)
+            hint = getattr(e, "retry_after_hint", None)
             return reply(
                 "503 Service Unavailable",
                 {"error": str(e), "kind": type(e).__name__},
-                {"Retry-After": _adaptive_retry_after(self._engine)},
+                {"Retry-After": str(hint) if hint
+                 else _adaptive_retry_after(self._engine)},
             )
         except ValueError as e:
+            if wal_entry is not None:
+                wal.forget(rid_box["rid"], e)
             return reply(
                 "400 Bad Request",
                 {"error": str(e), "kind": "ValueError"},
             )
+        if wal_entry is not None:
+            # from here the tracker entry is the request's source of
+            # truth: the pump (owning the handle's queue) feeds it and
+            # the journal; this and any future connection stream FROM it
+            wal.bind(wal_entry, handle)
+            if stream:
+                self._stream_entry(conn, ctx, wal_entry, t0, resume_from)
+                return None
+            return self._reply_entry(reply, wal_entry)
         if stream:
-            self._stream_generate(conn, ctx, handle, t0)
+            self._stream_generate(conn, ctx, handle, t0, rid=rid_box["rid"])
             return None
         try:
             toks = handle.result(
@@ -1216,7 +1396,148 @@ class ScoringServer:
             handle=handle,
         )
 
-    def _stream_generate(self, conn, ctx, handle, t0: float) -> None:
+    def _stale_router_epoch(self, hdr) -> Optional[Tuple[int, int]]:
+        """``(placed, current)`` when a placement's ``x-router-epoch``
+        header is BELOW the election lease's current epoch — the
+        zombie-router case — else ``None`` (no fencing configured, no
+        header, or the lease is unreadable: a broken shared filesystem
+        must not reject live traffic)."""
+        if self._router_epoch_fn is None or hdr is None:
+            return None
+        try:
+            placed = int(hdr)
+        except (TypeError, ValueError):
+            return None
+        try:
+            cur = self._router_epoch_fn()
+        except Exception:
+            return None
+        if cur is None or placed >= int(cur):
+            return None
+        return placed, int(cur)
+
+    def _reply_entry(self, reply, entry):
+        """Answer a NON-streaming generate from a WAL tracker entry
+        (fresh admissions and duplicate-id dedupes both land here when
+        the durable plane is on): wait for the entry to settle — the
+        pump thread feeds it from the engine handle — then map its
+        outcome through the same status ladder the handle path uses."""
+        from ..utils.config import get_config
+
+        timeout_s = get_config().serve_result_timeout_s
+        deadline = time.monotonic() + timeout_s
+        with entry.cond:
+            while not entry.done:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return reply(
+                        "504 Gateway Timeout",
+                        {"error": f"no result within {timeout_s}s",
+                         "kind": "TimeoutError"},
+                        handle=entry.handle,
+                    )
+                entry.cond.wait(rem)
+            err = entry.error
+            toks = list(entry.tokens)
+        if err is not None:
+            kind, msg = err
+            status = (
+                "504 Gateway Timeout"
+                if kind in ("TimeoutError", "DeadlineExceededError")
+                else "500 Internal Server Error"
+            )
+            return reply(
+                status, {"error": msg, "kind": kind}, handle=entry.handle
+            )
+        return reply(
+            "200 OK",
+            {"tokens": [int(t) for t in toks]},
+            handle=entry.handle,
+        )
+
+    def _stream_entry(
+        self, conn, ctx, entry, t0: float, from_off: int = 0
+    ) -> None:
+        """NDJSON streaming from a WAL tracker entry — the durable
+        twin of :meth:`_stream_generate`. The already-delivered prefix
+        past ``from_off`` replays immediately (a reconnecting client
+        sends ``from=<count of tokens it already has>``), then the live
+        tail follows as the pump lands tokens, then exactly one
+        terminal line. Byte-identity of the replayed prefix with what
+        the torn connection delivered is inherited from the fleet's
+        deterministic replay — the tracker holds THE token sequence,
+        every connection is a view of it."""
+        import json
+
+        from ..utils.config import get_config
+
+        conn.sendall(
+            (
+                "HTTP/1.1 200 OK\r\n"
+                "Content-Type: application/x-ndjson; charset=utf-8\r\n"
+                f"traceparent: {ctx.traceparent()}\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode("latin-1")
+        )
+        cursor = max(0, int(from_off))
+        timeout_s = get_config().serve_result_timeout_s
+        sent = 0
+        terminal: Dict[str, Any]
+        try:
+            while True:
+                got = entry.wait(cursor, timeout_s)
+                if got is None:  # the no-emission backstop fired
+                    terminal = {
+                        "error": f"no emission within {timeout_s}s",
+                        "kind": "TimeoutError",
+                        "request_id": entry.rid,
+                    }
+                    break
+                new, done, err = got
+                for t in new:
+                    conn.sendall(
+                        (json.dumps({"t": int(t)}) + "\n").encode("utf-8")
+                    )
+                cursor += len(new)
+                sent += len(new)
+                if done:
+                    if err is None:
+                        total = time.perf_counter() - t0
+                        terminal = {
+                            "done": True,
+                            "request_id": entry.rid,
+                            "tokens_total": cursor,
+                            "trace_id": ctx.trace_id,
+                            "timing": self._timing_payload(
+                                entry.handle, total
+                            ),
+                        }
+                    else:
+                        terminal = {
+                            "error": err[1],
+                            "kind": err[0],
+                            "request_id": entry.rid,
+                        }
+                    break
+            conn.sendall((json.dumps(terminal) + "\n").encode("utf-8"))
+            status = "200" if terminal.get("done") else "error"
+        except OSError:
+            # the client went away (again): the pump keeps feeding the
+            # tracker and the journal, so the NEXT reconnect resumes
+            # from wherever the stream is by then
+            status = "client-gone"
+        _flight.record(
+            "serving", "generate_stream",
+            status=status,
+            trace_id=ctx.trace_id,
+            tokens=sent,
+            request_id=entry.rid,
+            resumed_from=int(from_off),
+            dur_s=round(time.perf_counter() - t0, 6),
+        )
+
+    def _stream_generate(self, conn, ctx, handle, t0: float,
+                         rid: Optional[str] = None) -> None:
         """The NDJSON success path of ``POST /generate`` with
         ``"stream": true``: headers first (no Content-Length — the
         stream's end is the connection's), then one ``{"t": token}``
@@ -1240,6 +1561,9 @@ class ScoringServer:
                 "Connection: close\r\n\r\n"
             ).encode("latin-1")
         )
+        # a client-supplied request_id is the stream's identity even
+        # without the durable plane: echo it, not the engine handle's
+        rid = rid if rid is not None else handle.request_id
         sent = 0
         timeout_s = get_config().serve_result_timeout_s
         terminal: Dict[str, Any]
@@ -1251,7 +1575,7 @@ class ScoringServer:
                     terminal = {
                         "error": f"no emission within {timeout_s}s",
                         "kind": "TimeoutError",
-                        "request_id": handle.request_id,
+                        "request_id": rid,
                     }
                     break
                 if item is handle._DONE:
@@ -1260,7 +1584,7 @@ class ScoringServer:
                         total = time.perf_counter() - t0
                         terminal = {
                             "done": True,
-                            "request_id": handle.request_id,
+                            "request_id": rid,
                             "tokens_total": sent,
                             "trace_id": ctx.trace_id,
                             "timing": self._timing_payload(handle, total),
@@ -1269,7 +1593,7 @@ class ScoringServer:
                         terminal = {
                             "error": str(err),
                             "kind": type(err).__name__,
-                            "request_id": handle.request_id,
+                            "request_id": rid,
                         }
                     break
                 conn.sendall(
@@ -1289,7 +1613,7 @@ class ScoringServer:
             status=status,
             trace_id=ctx.trace_id,
             tokens=sent,
-            request_id=handle.request_id,
+            request_id=rid,
             dur_s=round(time.perf_counter() - t0, 6),
         )
 

@@ -79,6 +79,7 @@ from ..utils import chaos as _chaos
 from ..utils.config import get_config
 from ..utils.failures import (
     DeadlineExceededError,
+    StaleLeaseError,
     TenantThrottledError,
     first_line as _first_line,
     run_with_retries,
@@ -426,6 +427,14 @@ class Fleet:
         #: point: registry scans, autoscaler evaluation. A hook that
         #: raises is logged and kept; it must not kill the watchdog.
         self._tick_hooks: List = []
+        #: the router-election epoch this fleet places under (None =
+        #: router HA not attached → no fencing header on remote
+        #: placements, the pre-HA wire format). Set by
+        #: ``serve/router_ha.py`` when this process wins the router
+        #: lease; deliberately LEFT at the stale value after a lease
+        #: loss so a zombie router's placements carry the superseded
+        #: epoch and members reject them (StaleRouterEpochError).
+        self.router_epoch: Optional[int] = None
         _m_replicas_healthy.set(float(len(self._replicas)))
 
     # -- introspection -----------------------------------------------------
@@ -662,6 +671,7 @@ class Fleet:
         deadline: Optional[float] = None,
         session: Optional[str] = None,
         tenant: Optional[str] = None,
+        _resume_tokens: Optional[Sequence[int]] = None,
     ) -> FleetHandle:
         """Place one request on a healthy replica; returns its streaming
         handle. Raises ``ValueError`` for infeasible requests (every
@@ -673,7 +683,20 @@ class Fleet:
         the same key to one replica while it stays healthy. ``tenant``
         labels the request's cost-attribution record
         (``obs/requests.py``); it defaults to the session key so
-        session-affine traffic is attributable without extra plumbing."""
+        session-affine traffic is attributable without extra plumbing.
+
+        ``_resume_tokens`` (router-HA internal, ``serve/router_ha.py``)
+        pre-seeds the handle with tokens a PREVIOUS router incarnation
+        already delivered, so placement goes through the same
+        recompute-style fold as a replica-death replay: the delivered
+        prefix folds into the prompt, the budget shrinks, per-step
+        sampling keys land at their absolute positions, and the stream
+        stays byte-identical across the takeover. Such a resubmission
+        skips the QoS admission gate — the request was admitted (and
+        billed) by the incarnation that journaled it; a takeover must
+        not re-charge or re-refuse it. A resume whose prefix already
+        covers the budget (or ended at EOS) settles immediately as
+        success."""
         if self._closed and self._thread is None:
             raise EngineUnhealthyError("fleet is stopped")
         if deadline is not None and deadline <= 0:
@@ -691,7 +714,7 @@ class Fleet:
             )
         prompt = np.asarray(prompt, np.int32).ravel()
         tenant_key = str(tenant if tenant is not None else (session or ""))
-        if _tenancy.enabled():
+        if _tenancy.enabled() and _resume_tokens is None:
             # the fleet-wide QoS gate, charged ONCE here: the replica
             # engines skip their own check on the relay path
             # (_handle_factory set), so a request is never billed
@@ -722,6 +745,13 @@ class Fleet:
         # context around this call; a fresh submit inherits any ambient
         # trace the same way)
         rec.trace = _current_trace()
+        if _resume_tokens is not None:
+            # a takeover resubmission: the previous incarnation's
+            # delivered watermark becomes the handle's emitted prefix,
+            # and _submit_to's fold does the rest (prompt + prefix,
+            # shrunken budget). Safe to append directly — no relay has
+            # been attached yet, so nothing else touches the handle.
+            rec.handle._tokens.extend(int(t) for t in _resume_tokens)
         t_end = None if timeout is None else time.monotonic() + timeout
         while True:
             cands = run_with_retries(
@@ -732,6 +762,12 @@ class Fleet:
             for rep in cands:
                 try:
                     self._submit_to(rep, rec)
+                except _StreamComplete:
+                    # only reachable for a _resume_tokens submission
+                    # (a fresh record never starts complete): the WAL
+                    # prefix already covers the budget or ends at EOS
+                    rec.handle._finish(None)
+                    return rec.handle
                 except QueueFullError as e:
                     exhausted = e
                     continue
@@ -810,10 +846,17 @@ class Fleet:
         request (``ValueError``) is infeasible on every identical
         replica, and a QoS throttle (``TenantThrottledError``) refused
         the TENANT — replaying would re-run work the admission gate
-        rejected."""
+        rejected. A stale-epoch rejection (``StaleLeaseError`` /
+        ``StaleRouterEpochError``) means THIS router was fenced — a
+        member refusing a zombie's placement refuses it everywhere, so
+        replaying would only hammer survivors with writes the fence
+        exists to reject."""
         return not isinstance(
             error,
-            (DeadlineExceededError, ValueError, TenantThrottledError),
+            (
+                DeadlineExceededError, ValueError, TenantThrottledError,
+                StaleLeaseError,
+            ),
         )
 
     def _on_inner_finish(

@@ -72,7 +72,10 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import socket
+import subprocess
+import sys
 import threading
 import time
 import uuid
@@ -87,6 +90,7 @@ from ..utils.config import get_config
 from ..utils.failures import (
     DeadlineExceededError,
     StaleLeaseError,
+    StaleRouterEpochError,
     TenantThrottledError,
     run_with_retries,
 )
@@ -94,10 +98,12 @@ from ..utils.leases import LeaseStore, LeaseView
 from ..utils.logging import get_logger
 from .engine import EngineUnhealthyError
 from .fleet import Fleet
+from .router_ha import ROUTER_LEASE_KEY, router_epoch_from
 from .scheduler import GenerationHandle, QueueFullError
 
 __all__ = [
     "Autoscaler",
+    "LocalProcessProvisioner",
     "MemberAgent",
     "MemberRegistry",
     "RemoteEngine",
@@ -460,6 +466,11 @@ class RemoteEngine:
         "ValueError": ValueError,
         "DeadlineExceededError": DeadlineExceededError,
         "TimeoutError": TimeoutError,
+        # the member refused a ZOMBIE router's placement (its
+        # x-router-epoch is below the election lease's current epoch,
+        # serve/router_ha.py) — non-replayable: the new active router
+        # already owns this request
+        "StaleRouterEpochError": StaleRouterEpochError,
     }
 
     def __init__(
@@ -477,6 +488,11 @@ class RemoteEngine:
         self.max_seq_len = int(max_seq_len)
         self.connect_timeout_s = float(connect_timeout_s)
         self.healthy = True
+        #: ``() -> Optional[int]``: the placing fleet's router-election
+        #: epoch (set by the membership sync when router HA is attached;
+        #: ``serve/router_ha.py``). None / returning None → no fencing
+        #: header on the wire, the pre-HA format.
+        self.router_epoch_fn: Optional[Callable[[], Optional[int]]] = None
         self._stop_wedged = False
         self._thread = None
         self._poison = None
@@ -623,12 +639,23 @@ class RemoteEngine:
         with self._id_lock:
             self._req_counter += 1
             rid = self._req_counter
+        router_epoch = None
+        if self.router_epoch_fn is not None:
+            try:
+                router_epoch = self.router_epoch_fn()
+            except Exception:
+                router_epoch = None
         conn = None
         try:
             conn = self._connect()
             extra = (
                 f"traceparent: {traceparent}\r\n" if traceparent else ""
             )
+            if router_epoch is not None:
+                # the fencing token: a member whose election-lease view
+                # is AHEAD of this epoch rejects the placement (zombie
+                # router; serve/router_ha.py)
+                extra += f"x-router-epoch: {int(router_epoch)}\r\n"
             conn.sendall(
                 (
                     f"POST /generate HTTP/1.1\r\n"
@@ -642,12 +669,25 @@ class RemoteEngine:
             f = conn.makefile("rb")
             status_line = f.readline().decode("latin-1", "replace")
             status = int(status_line.split(" ", 2)[1])
-            while f.readline() not in (b"\r\n", b"\n", b""):
-                pass
+            # keep the refusal headers: the member's own Retry-After
+            # must reach the ultimate client verbatim, not be
+            # recomputed from this router's (different) backlog
+            resp_headers: Dict[str, str] = {}
+            while True:
+                line = f.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.partition(b":")
+                resp_headers[
+                    k.strip().lower().decode("latin-1", "replace")
+                ] = v.strip().decode("latin-1", "replace")
             if status != 200:
                 raw = f.read()
                 conn.close()
-                self._raise_refusal(status, raw)
+                self._raise_refusal(
+                    status, raw,
+                    retry_after=resp_headers.get("retry-after"),
+                )
         except (OSError, IndexError, ValueError) as e:
             # the member went away between the health poll and this
             # placement (or refused the connection outright): shaped as
@@ -683,7 +723,18 @@ class RemoteEngine:
         reader.start()
         return handle
 
-    def _raise_refusal(self, status: int, raw: bytes) -> None:
+    def _raise_refusal(
+        self,
+        status: int,
+        raw: bytes,
+        retry_after: Optional[str] = None,
+    ) -> None:
+        """Re-raise a member's pre-submit refusal as the exception
+        class it named. ``retry_after`` (the member's literal
+        ``Retry-After`` header) rides the exception as
+        ``retry_after_hint`` so the serving layer fronting this router
+        can echo the MEMBER's verbatim hint to the client instead of
+        recomputing one from the router's own (empty) backlog."""
         try:
             body = json.loads(raw.decode("utf-8")) if raw else {}
         except ValueError:
@@ -692,18 +743,25 @@ class RemoteEngine:
         msg = str(
             body.get("error", f"member {self.name} answered {status}")
         )
+
+        def _hinted(exc: BaseException) -> BaseException:
+            exc.retry_after_hint = retry_after
+            return exc
+
         if kind == "TenantThrottledError":
-            raise TenantThrottledError(
-                msg,
-                retry_after=float(body.get("retry_after", 1.0)),
-                reason=str(body.get("reason", "quota")),
-                tenant=str(body.get("tenant", "")),
+            raise _hinted(
+                TenantThrottledError(
+                    msg,
+                    retry_after=float(body.get("retry_after", 1.0)),
+                    reason=str(body.get("reason", "quota")),
+                    tenant=str(body.get("tenant", "")),
+                )
             )
         exc_cls = self._KIND_MAP.get(kind)
         if exc_cls is not None:
-            raise exc_cls(msg)
+            raise _hinted(exc_cls(msg))
         if status in (503, 501):
-            raise EngineUnhealthyError(msg)
+            raise _hinted(EngineUnhealthyError(msg))
         if status == 400:
             raise ValueError(msg)
         raise RuntimeError(f"member {self.name}: HTTP {status}: {msg}")
@@ -851,13 +909,20 @@ class MemberAgent:
         self._state_lock = threading.Lock()
         self._old_params: Optional[Dict[str, Any]] = None
         self._shutdown_done = threading.Event()
+        kw = dict(server_kwargs or {})
+        # the member-side half of zombie-router fencing: /generate
+        # compares a placement's x-router-epoch header against the
+        # election lease's current epoch in the shared registry dir and
+        # answers 409 StaleRouterEpochError when it is superseded
+        # (serve/router_ha.py; cached scan, ~one clock read/request)
+        kw.setdefault("router_epoch_fn", router_epoch_from(registry))
         self.server = ScoringServer(
             engine=engine,
             host=host,
             port=port,
             readiness=self._readiness,
             lifecycle=self._lifecycle,
-            **(server_kwargs or {}),
+            **kw,
         )
 
     # -- state -------------------------------------------------------------
@@ -1135,6 +1200,11 @@ class _MemberSync:
         live = 0
         for view in views:
             name = view.key
+            if name == ROUTER_LEASE_KEY:
+                # the router-ELECTION lease (serve/router_ha.py) shares
+                # the directory; it is not a member and must never be
+                # fenced/joined as one
+                continue
             seen.add(name)
             if view.terminal:
                 if name in roster:
@@ -1152,6 +1222,15 @@ class _MemberSync:
             state = str(view.meta.get("state", "ready"))
             if name not in roster:
                 eng = self._engine_factory(name, view.meta)
+                try:
+                    # placements carry the fleet's election epoch as a
+                    # fencing header once router HA activates; reading
+                    # it live (not captured) tracks takeover/demotion
+                    eng.router_epoch_fn = (
+                        lambda: getattr(self.fleet, "router_epoch", None)
+                    )
+                except Exception:
+                    pass  # duck-typed factory engine without the attr
                 try:
                     fleet._add_replica(name, eng)
                 except ValueError:
@@ -1600,3 +1679,157 @@ class Autoscaler:
 
         self.fleet._tick_hooks.append(tick)
         return self
+
+
+class LocalProcessProvisioner:
+    """A REAL actuator behind :class:`Autoscaler`'s ``scale_up`` /
+    ``scale_down`` callbacks: spawn and retire :class:`MemberAgent`
+    subprocesses on this host (the single-host closing of ROADMAP item
+    3's "real provisioner" remainder; a cloud provisioner swaps in the
+    same two callbacks).
+
+    ``script`` is the member's ``python -c`` source; it is launched as
+    ``python -c <script> <registry_path> <member_name> [*extra_args]``
+    and is expected to build an engine, construct a
+    :class:`MemberAgent` on the shared ``path``, call
+    :meth:`MemberAgent.install_sigterm`, start, and serve until
+    signaled — retirement is a SIGTERM, so the member drains
+    gracefully (stop admission, finish in-flight streams, resign the
+    lease) rather than being fenced as a death.
+
+    Bounded by ``max_procs`` (scale-up past it is a logged no-op —
+    the autoscaler's own ``max_members``/``cooldown_s`` guard rails
+    stay in charge of WHEN); scale-down only ever retires processes
+    THIS provisioner spawned, newest first, so externally-managed
+    members are untouchable from here."""
+
+    def __init__(
+        self,
+        path: str,
+        script: str,
+        *,
+        python: Optional[str] = None,
+        base_name: str = "auto",
+        max_procs: int = 8,
+        extra_args: Tuple[str, ...] = (),
+        env: Optional[Dict[str, str]] = None,
+        term_grace_s: float = 10.0,
+    ):
+        self.path = str(path)
+        self.script = script
+        self.python = python or sys.executable
+        self.base_name = str(base_name)
+        self.max_procs = int(max_procs)
+        self.extra_args = tuple(str(a) for a in extra_args)
+        self.env = dict(env) if env is not None else None
+        self.term_grace_s = float(term_grace_s)
+        self._procs: "Dict[str, subprocess.Popen]" = {}
+        self._order: List[str] = []  # spawn order; retire newest first
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def reap(self) -> List[str]:
+        """Forget exited processes; returns the names reaped."""
+        gone = []
+        with self._lock:
+            for name, proc in list(self._procs.items()):
+                if proc.poll() is not None:
+                    gone.append(name)
+                    del self._procs[name]
+                    self._order.remove(name)
+        return gone
+
+    @property
+    def alive(self) -> int:
+        self.reap()
+        with self._lock:
+            return len(self._procs)
+
+    def names(self) -> List[str]:
+        self.reap()
+        with self._lock:
+            return list(self._order)
+
+    def scale_up(self) -> Optional[str]:
+        """Spawn one member subprocess; returns its name, or ``None``
+        at the ``max_procs`` bound."""
+        self.reap()
+        with self._lock:
+            if len(self._procs) >= self.max_procs:
+                logger.warning(
+                    "provisioner: scale_up refused at the max_procs "
+                    "bound (%d)", self.max_procs,
+                )
+                return None
+            self._seq += 1
+            name = f"{self.base_name}-{self._seq}"
+        env = None
+        if self.env is not None:
+            env = dict(os.environ)
+            env.update(self.env)
+        proc = subprocess.Popen(
+            [self.python, "-c", self.script, self.path, name,
+             *self.extra_args],
+            env=env,
+        )
+        with self._lock:
+            self._procs[name] = proc
+            self._order.append(name)
+        _flight.record(
+            "membership", "provision", member=name, pid=proc.pid,
+        )
+        logger.warning(
+            "provisioner: spawned member %s (pid %d)", name, proc.pid,
+        )
+        return name
+
+    def scale_down(self) -> Optional[str]:
+        """SIGTERM the newest member this provisioner owns (graceful
+        drain + resign via :meth:`MemberAgent.install_sigterm`);
+        returns its name, or ``None`` with nothing to retire."""
+        self.reap()
+        with self._lock:
+            if not self._order:
+                return None
+            name = self._order[-1]
+            proc = self._procs[name]
+        try:
+            proc.send_signal(signal.SIGTERM)
+        except OSError:
+            pass  # exited under us; the next reap forgets it
+        _flight.record("membership", "retire", member=name, pid=proc.pid)
+        logger.warning(
+            "provisioner: retiring member %s (pid %d, SIGTERM)",
+            name, proc.pid,
+        )
+        return name
+
+    def autoscaler(self, fleet: Fleet, **kw: Any) -> Autoscaler:
+        """Convenience: an :class:`Autoscaler` with this provisioner's
+        callbacks bound (``max_members`` defaults to ``max_procs``)."""
+        kw.setdefault("max_members", self.max_procs)
+        return Autoscaler(
+            fleet, scale_up=self.scale_up, scale_down=self.scale_down,
+            **kw,
+        )
+
+    def stop(self) -> None:
+        """Retire everything: SIGTERM all, wait out the grace period,
+        SIGKILL leftovers."""
+        with self._lock:
+            procs = list(self._procs.values())
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.term_grace_s
+        for proc in procs:
+            rem = deadline - time.monotonic()
+            try:
+                proc.wait(timeout=max(0.0, rem))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+        self.reap()

@@ -1,0 +1,792 @@
+"""Router high availability: durable request WAL + fenced standby takeover.
+
+PR 18 (``serve/membership.py``) made *members* survive kill -9 with
+byte-identical failover replay; this module does the same for the
+ROUTER, the serving plane's last single point of failure. Three pieces,
+all built on primitives the repo already has:
+
+- **Request WAL** (:class:`RequestWAL`) — an append-only per-request
+  journal in the shared fleet directory (the same directory the
+  membership leases live in, the same append-only + torn-tail-tolerant
+  discipline as ``engine/jobs.py``'s BlockLedger). It records each
+  request's ADMISSION (prompt, sampling params, tenant, session,
+  traceparent, and the client-supplied idempotent ``request_id``) and a
+  delivered-token WATERMARK. Every write happens off the relay path —
+  a per-request pump thread feeds an in-process tracker entry, and one
+  background writer thread batches journal appends — so the token hot
+  loop stays ~free; the whole plane is additionally gated zero-cost-off
+  by ``Config.router_wal`` (the tenancy/chaos module-global pattern).
+
+- **Resumable streams** — the tracker entry is what
+  ``interop/serving.py`` streams from when a ``request_id`` is
+  supplied: a duplicate submit dedupes against it instead of
+  double-generating, and a disconnected client reconnects with
+  ``request_id`` + ``from=<offset>`` to get the already-delivered
+  prefix replayed followed by the live tail, byte-identical to the
+  uninterrupted stream.
+
+- **Fenced standby takeover** (:class:`RouterHA`) — routers elect an
+  active via an epoch-fenced lease on the shared directory (exactly
+  the ``MemberRegistry`` fencing pattern, key :data:`ROUTER_LEASE_KEY`
+  — filtered out of member scans). A standby detects lease expiry,
+  wins epoch+1, rebuilds in-flight state from the WAL, and resubmits
+  unfinished requests recompute-style through
+  ``Fleet.submit(_resume_tokens=...)``: the delivered watermark folds
+  into the prompt and per-step sampling keys fold at their absolute
+  positions, so resumed streams are byte-identical. Members learn the
+  current router epoch from the same lease file and reject a zombie
+  router's stale-epoch placements
+  (:class:`~tensorframes_tpu.utils.failures.StaleRouterEpochError`).
+  A router that LOSES the lease deliberately keeps its stale
+  ``fleet.router_epoch`` — its late placements must carry the
+  superseded epoch so the rejection fires.
+
+Chaos sites: ``fleet.router_wal`` (journal flush — ``transient``
+retries invisibly, ``latency`` lags the watermark, which only means a
+takeover replays a little more, still byte-identical) and
+``fleet.router_heartbeat`` (the election tick — ``latency`` past the
+TTL is the takeover drill). See docs/fault_tolerance.md "Router HA".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import re
+import socket
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..obs import flight as _flight
+from ..obs.metrics import counter as _counter
+from ..utils import chaos as _chaos
+from ..utils.config import get_config, register_on_change
+from ..utils.failures import first_line as _first_line, run_with_retries
+from ..utils.leases import LeaseStore
+from ..utils.logging import get_logger
+
+__all__ = [
+    "ROUTER_LEASE_KEY",
+    "RequestWAL",
+    "RouterHA",
+    "attach_router_ha",
+    "enabled",
+    "router_epoch_from",
+]
+
+logger = get_logger("serve.router_ha")
+
+#: the router-election lease's key in the shared directory — a RESERVED
+#: name the membership sync skips, so the election lease is never
+#: mistaken for a serving member
+ROUTER_LEASE_KEY = "router"
+
+_m_takeovers = _counter(
+    "fleet.router_takeovers_total",
+    "Router activations at epoch > 0: a standby (or restarted router) "
+    "won the election lease past a previous incarnation and rebuilt "
+    "in-flight state from the request WAL",
+)
+_m_wal_records = _counter(
+    "fleet.wal_records_total",
+    "Records appended to the router's request WAL, by event "
+    "(admit / tok / done / err)",
+    labels=("event",),
+)
+
+# -- the zero-cost-off gate (the tenancy/chaos module-global pattern) ------
+
+_ON = False
+
+
+def _refresh() -> None:
+    global _ON
+    _ON = bool(get_config().router_wal)
+
+
+register_on_change(_refresh)
+
+
+def enabled() -> bool:
+    """Whether the durable request plane is on (``Config.router_wal``)."""
+    return _ON
+
+
+#: ledger filename per router incarnation: the election epoch makes the
+#: name unique, so two incarnations can never interleave appends in one
+#: file and a torn tail is always the LAST line of exactly one file
+_LEDGER_RE = re.compile(r"^wal\.e(\d+)\.jsonl$")
+
+#: tracker-entry table bound: beyond this many entries the oldest
+#: COMPLETED entries are forgotten (dedupe/resume of a long-finished
+#: request degrades to a fresh admission — an optimization bound, not a
+#: correctness one; the journal itself keeps every record)
+_MAX_ENTRIES = 8192
+
+
+class _WalEntry:
+    """One tracked request: the in-process twin of its WAL records —
+    what resumable streams are served from. ``tokens`` grows under
+    ``cond``; ``done``/``error`` settle exactly once."""
+
+    __slots__ = (
+        "rid", "record", "tokens", "done", "error", "cond", "handle",
+        "created_t",
+    )
+
+    def __init__(self, rid: str, record: Dict[str, Any]):
+        self.rid = rid
+        self.record = record
+        self.tokens: List[int] = []
+        self.done = False
+        self.error: Optional[Tuple[str, str]] = None  # (kind, message)
+        self.cond = threading.Condition()
+        self.handle = None
+        self.created_t = time.monotonic()
+
+    def wait(
+        self, cursor: int, timeout_s: float
+    ) -> Optional[Tuple[List[int], bool, Optional[Tuple[str, str]]]]:
+        """Block until tokens beyond ``cursor`` exist or the entry is
+        terminal; returns ``(new_tokens, done, error)`` or ``None`` on
+        timeout."""
+        deadline = time.monotonic() + timeout_s
+        with self.cond:
+            while len(self.tokens) <= cursor and not self.done:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return None
+                self.cond.wait(rem)
+            return list(self.tokens[cursor:]), self.done, self.error
+
+
+class RequestWAL:
+    """The append-only per-request journal plus its in-process tracker.
+
+    One JSONL ledger per router incarnation
+    (``<path>/wal/wal.e<epoch>.jsonl``), records::
+
+        {"e": "admit", "rid", "rec": {prompt, max_new, temperature,
+         top_p, seed, eos_id, session, tenant, trace, deadline_s}}
+        {"e": "tok",   "rid", "off": <absolute offset>, "t": [ids]}
+        {"e": "done",  "rid", "n": <tokens total>}
+        {"e": "err",   "rid", "kind", "msg"}
+
+    Appends ride a background writer thread (batched, fsynced, chaos
+    site ``fleet.router_wal`` inside a transient-retry window) so the
+    relay hot loop never touches the disk. Because replay is
+    byte-identical, token records from DIFFERENT router epochs agree
+    wherever their offsets overlap — recovery merges ledgers by setting
+    tokens at absolute offsets, and duplicates are harmless. A torn
+    last line (the crash artifact append-only files allow) is skipped
+    on load, exactly the BlockLedger discipline."""
+
+    def __init__(self, path: str, router_id: str):
+        self.dir = os.path.join(path, "wal")
+        self.router_id = router_id
+        self._entries: "OrderedDict[str, _WalEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._writer: Optional[threading.Thread] = None
+        self._file = None
+        self._ledger: Optional[str] = None
+        self.epoch: Optional[int] = None
+        self.records_written = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self, epoch: int) -> None:
+        """Start journaling into this incarnation's ledger."""
+        os.makedirs(self.dir, exist_ok=True)
+        self.epoch = int(epoch)
+        if self._file is not None:
+            # re-activation at a later epoch: appends go to the NEW
+            # incarnation's ledger from here on
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        self._ledger = os.path.join(
+            self.dir, f"wal.e{int(epoch):06d}.jsonl"
+        )
+        if self._writer is None or not self._writer.is_alive():
+            self._stop.clear()
+            self._writer = threading.Thread(
+                target=self._write_loop,
+                name=f"tft-router-wal-{self.router_id}",
+                daemon=True,
+            )
+            self._writer.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._q.put(None)  # wake the writer for its final drain
+        w = self._writer
+        if w is not None:
+            w.join(timeout=5.0)
+        self._writer = None
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+
+    # -- the tracker -------------------------------------------------------
+
+    def lookup(self, rid: str) -> Optional[_WalEntry]:
+        with self._lock:
+            return self._entries.get(str(rid))
+
+    def admit(
+        self, rid: str, record: Dict[str, Any]
+    ) -> Tuple[_WalEntry, bool]:
+        """Check-and-create for one request id: returns ``(entry,
+        created)``. ``created=False`` means a duplicate submit (or a
+        reconnect) — the caller serves from the existing entry instead
+        of generating again."""
+        rid = str(rid)
+        with self._lock:
+            cur = self._entries.get(rid)
+            if cur is not None:
+                return cur, False
+            entry = _WalEntry(rid, dict(record))
+            self._entries[rid] = entry
+            self._evict_done_locked()
+        self._append({"e": "admit", "rid": rid, "rec": dict(record)})
+        return entry, True
+
+    def admit_recovered(
+        self,
+        rid: str,
+        record: Dict[str, Any],
+        tokens: List[int],
+        done: bool,
+        error: Optional[Tuple[str, str]],
+    ) -> _WalEntry:
+        """Rebuild one request's entry from recovered ledgers, and
+        snapshot it into THIS incarnation's ledger so each epoch's file
+        is self-contained (old files become garbage-collectable once a
+        takeover has re-journaled them)."""
+        rid = str(rid)
+        entry = _WalEntry(rid, dict(record))
+        entry.tokens = [int(t) for t in tokens]
+        entry.done = bool(done)
+        entry.error = error
+        with self._lock:
+            self._entries[rid] = entry
+            self._evict_done_locked()
+        self._append({"e": "admit", "rid": rid, "rec": dict(record)})
+        if entry.tokens:
+            self._append(
+                {"e": "tok", "rid": rid, "off": 0, "t": list(entry.tokens)}
+            )
+        if error is not None:
+            self._append(
+                {"e": "err", "rid": rid, "kind": error[0], "msg": error[1]}
+            )
+        elif done:
+            self._append({"e": "done", "rid": rid, "n": len(entry.tokens)})
+        return entry
+
+    def _evict_done_locked(self) -> None:
+        while len(self._entries) > _MAX_ENTRIES:
+            victim = None
+            for key, e in self._entries.items():
+                if e.done:
+                    victim = key
+                    break
+            if victim is None:
+                return  # every entry is live; never evict one mid-stream
+            del self._entries[victim]
+
+    def bind(self, entry: _WalEntry, handle) -> None:
+        """Attach a live engine handle to the entry and start its pump:
+        a daemon thread draining the handle's token queue into the
+        tracker (and the journal). The pump OWNS the handle's queue —
+        the serving layer streams from the entry, never the queue."""
+        entry.handle = handle
+        threading.Thread(
+            target=self._pump,
+            args=(entry, handle),
+            name=f"tft-router-wal-pump-{entry.rid}",
+            daemon=True,
+        ).start()
+
+    def fail(self, rid: str, exc: BaseException) -> None:
+        """Settle an entry with an error without a live handle (e.g. a
+        takeover resubmission the fleet refused)."""
+        entry = self.lookup(rid)
+        if entry is None:
+            return
+        self._settle(entry, (type(exc).__name__, _first_line(exc)))
+
+    def forget(self, rid: str, exc: BaseException) -> None:
+        """Drop a REFUSED admission (429/503/400 before any token):
+        journals the refusal so a takeover never resubmits work the
+        admission gate rejected, then frees the id — a client retry
+        with the same ``request_id`` re-admits fresh instead of
+        deduping against a dead entry."""
+        rid = str(rid)
+        with self._lock:
+            self._entries.pop(rid, None)
+        self._append(
+            {
+                "e": "err", "rid": rid,
+                "kind": type(exc).__name__, "msg": _first_line(exc),
+            }
+        )
+
+    def _pump(self, entry: _WalEntry, handle) -> None:
+        timeout_s = get_config().serve_result_timeout_s
+        while True:
+            try:
+                item = handle._q.get(timeout=timeout_s)
+            except queue.Empty:
+                self._settle(
+                    entry,
+                    ("TimeoutError", f"no emission within {timeout_s}s"),
+                )
+                return
+            if item is handle._DONE:
+                err = handle.error
+                self._settle(
+                    entry,
+                    None
+                    if err is None
+                    else (type(err).__name__, _first_line(err)),
+                )
+                return
+            with entry.cond:
+                off = len(entry.tokens)
+                entry.tokens.append(int(item))
+                entry.cond.notify_all()
+            self._append(
+                {"e": "tok", "rid": entry.rid, "off": off, "t": [int(item)]}
+            )
+
+    def _settle(
+        self, entry: _WalEntry, error: Optional[Tuple[str, str]]
+    ) -> None:
+        with entry.cond:
+            if entry.done:
+                return
+            entry.done = True
+            entry.error = error
+            entry.cond.notify_all()
+        if error is None:
+            self._append(
+                {"e": "done", "rid": entry.rid, "n": len(entry.tokens)}
+            )
+        else:
+            self._append(
+                {
+                    "e": "err", "rid": entry.rid,
+                    "kind": error[0], "msg": error[1],
+                }
+            )
+
+    # -- the journal -------------------------------------------------------
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        self._q.put(rec)
+
+    def _write_loop(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            batch = [] if item is None else [item]
+            while True:  # drain whatever accumulated behind it
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is not None:
+                    batch.append(nxt)
+            if batch:
+                try:
+                    run_with_retries(
+                        lambda: self._flush(batch), what="fleet.router_wal"
+                    )
+                except Exception:
+                    # durability degraded (disk gone, fatal chaos) —
+                    # never let the journal take serving down with it;
+                    # a takeover simply replays more from the prompt
+                    logger.warning(
+                        "router_ha: WAL flush failed; %d record(s) "
+                        "dropped", len(batch), exc_info=True,
+                    )
+            if self._stop.is_set() and self._q.empty():
+                return
+
+    def _flush(self, batch: List[Dict[str, Any]]) -> None:
+        _chaos.site("fleet.router_wal")
+        if self._file is None:
+            self._file = open(self._ledger, "ab")
+        payload = b"".join(
+            json.dumps(rec, separators=(",", ":")).encode("utf-8") + b"\n"
+            for rec in batch
+        )
+        self._file.write(payload)
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        self.records_written += len(batch)
+        for rec in batch:
+            _m_wal_records.inc(event=str(rec.get("e", "?")))
+
+    # -- recovery ----------------------------------------------------------
+
+    def recover(self) -> Dict[str, Dict[str, Any]]:
+        """Merge every PREVIOUS incarnation's ledger into per-request
+        state: ``{rid: {record, tokens, done, error}}``. Token records
+        are applied at their absolute offsets — overlapping records
+        from different epochs are identical by the byte-identity
+        guarantee, so duplicates are no-ops and the merged watermark is
+        the max across ledgers. Undecodable lines (the torn tail a
+        kill -9 mid-append leaves) are skipped."""
+        state: Dict[str, Dict[str, Any]] = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return state
+        ledgers = []
+        for name in names:
+            m = _LEDGER_RE.match(name)
+            if m is None:
+                continue
+            epoch = int(m.group(1))
+            if self.epoch is not None and epoch >= self.epoch:
+                continue  # our own (or a future) ledger, not history
+            ledgers.append((epoch, os.path.join(self.dir, name)))
+        for _, path in sorted(ledgers):
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+            except OSError:
+                continue
+            for line in raw.splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line.decode("utf-8"))
+                except ValueError:
+                    continue  # torn tail / crash artifact
+                if not isinstance(rec, dict):
+                    continue
+                rid = str(rec.get("rid", ""))
+                ev = rec.get("e")
+                if ev == "admit":
+                    cur = state.get(rid)
+                    if cur is None or cur["done"]:
+                        # a re-admission AFTER a settled outcome is a
+                        # client retry of a refused/failed id (forget()
+                        # freed it): the retry's lifecycle replaces the
+                        # stale one instead of merging into it
+                        state[rid] = {
+                            "record": dict(rec.get("rec") or {}),
+                            "tokens": [], "done": False, "error": None,
+                        }
+                    continue
+                st = state.get(rid)
+                if st is None:
+                    continue  # records for an admission we never saw
+                if ev == "tok":
+                    toks = st["tokens"]
+                    off = int(rec.get("off", 0))
+                    for i, t in enumerate(rec.get("t") or []):
+                        pos = off + i
+                        if pos < len(toks):
+                            continue  # overlap: identical by replay
+                        if pos == len(toks):
+                            toks.append(int(t))
+                        else:
+                            break  # a gap — trust only the contiguous prefix
+                elif ev == "done":
+                    st["done"] = True
+                elif ev == "err":
+                    st["done"] = True
+                    st["error"] = (
+                        str(rec.get("kind", "RuntimeError")),
+                        str(rec.get("msg", "")),
+                    )
+        return state
+
+    def statusz_view(self) -> Dict[str, Any]:
+        with self._lock:
+            entries = len(self._entries)
+            live = sum(1 for e in self._entries.values() if not e.done)
+        return {
+            "dir": self.dir,
+            "epoch": self.epoch,
+            "entries": entries,
+            "live": live,
+            "records_written": self.records_written,
+            "queue_depth": self._q.qsize(),
+        }
+
+
+def router_epoch_from(
+    store: LeaseStore, cache_s: float = 0.25
+) -> "Any":
+    """Build a cached ``() -> Optional[int]`` reading the router
+    election lease's current epoch from ``store``'s directory — the
+    member-side half of zombie-router fencing (``interop/serving.py``
+    compares it against the placement's ``x-router-epoch`` header).
+    Cached for ``cache_s`` so the per-request cost is a clock read, and
+    degrades to ``None`` (no fencing) when the lease is unreadable —
+    a broken shared filesystem must not reject live traffic."""
+    state = {"t": -1e9, "epoch": None}
+    lock = threading.Lock()
+
+    def current() -> Optional[int]:
+        now = time.monotonic()
+        with lock:
+            if now - state["t"] < cache_s:
+                return state["epoch"]
+            state["t"] = now
+        try:
+            view = store._scan(ROUTER_LEASE_KEY)
+            epoch = None if view is None else int(view.epoch)
+        except Exception:
+            epoch = None
+        with lock:
+            state["epoch"] = epoch
+        return epoch
+
+    return current
+
+
+class RouterHA:
+    """One router process's election + takeover state machine.
+
+    Rides the fleet's watchdog tick (:meth:`tick` is a tick hook): the
+    ACTIVE router holds the election lease (key
+    :data:`ROUTER_LEASE_KEY`) with the lease store's own heartbeat
+    renewing it; a STANDBY polls ``acquire()`` — which only succeeds
+    once the active's lease has EXPIRED — and wins at epoch+1. Winning
+    at epoch > 0 is a takeover: the WAL's previous-incarnation ledgers
+    are merged, finished requests become servable (resume of a
+    completed stream replays from the journal), and unfinished ones are
+    resubmitted through ``Fleet.submit(_resume_tokens=...)`` — the
+    delivered watermark folds into the prompt, so the stream continues
+    byte-identically from the next undelivered position.
+
+    Losing the lease (the heartbeat's ``on_lost``) demotes to a FENCED
+    zombie: admission stops (serving answers 503 while
+    :attr:`active` is False), and ``fleet.router_epoch`` deliberately
+    keeps the superseded epoch so any in-flight placement is rejected
+    member-side."""
+
+    def __init__(
+        self,
+        fleet,
+        path: str,
+        *,
+        name: Optional[str] = None,
+        ttl_s: Optional[float] = None,
+        heartbeat_s: Optional[float] = None,
+    ):
+        cfg = get_config()
+        self.fleet = fleet
+        self.name = name or (
+            f"router-{socket.gethostname()}-{os.getpid()}"
+        )
+        ttl = float(
+            cfg.router_lease_ttl_s if ttl_s is None else ttl_s
+        )
+        self.store = LeaseStore(
+            path,
+            worker_id=self.name,
+            ttl_s=ttl,
+            heartbeat_s=0.0 if heartbeat_s is None else float(heartbeat_s),
+        )
+        self.store.on_lost = self._on_lease_lost
+        self.wal = RequestWAL(path, router_id=self.name)
+        self.active = False
+        self.fenced = False
+        self.epoch: Optional[int] = None
+        self.resumed_requests = 0
+        self._interval = max(0.05, ttl / 3.0)
+        self._last_tick = -1e9
+        self._lock = threading.Lock()
+        self._taking_over = False
+
+    # -- election ----------------------------------------------------------
+
+    def tick(self) -> None:
+        """The election heartbeat, run on the fleet watchdog tick
+        (rate-limited to a third of the TTL). The ACTIVE router's lease
+        renewal rides the store's own heartbeat thread; this tick only
+        campaigns while standby/fenced."""
+        now = time.monotonic()
+        if now - self._last_tick < self._interval:
+            return
+        self._last_tick = now
+        _chaos.site("fleet.router_heartbeat")
+        with self._lock:
+            if self.active or self._taking_over:
+                return
+        epoch = self.store.acquire(
+            ROUTER_LEASE_KEY, meta={"router": self.name}
+        )
+        if epoch is None:
+            return
+        with self._lock:
+            self._taking_over = True
+        # recovery + resubmission off the watchdog thread: a takeover
+        # that waits on queue room must not stall health polling or the
+        # failover drain that the resubmissions themselves depend on
+        threading.Thread(
+            target=self._become_active,
+            args=(int(epoch),),
+            name=f"tft-router-takeover-{self.name}",
+            daemon=True,
+        ).start()
+
+    def _become_active(self, epoch: int) -> None:
+        try:
+            self.epoch = epoch
+            self.fleet.router_epoch = epoch
+            self.wal.open(epoch)
+            recovered = self.wal.recover() if epoch > 0 else {}
+            if epoch > 0:
+                _m_takeovers.inc()
+                _flight.record(
+                    "router_ha", "takeover", router=self.name,
+                    epoch=epoch, recovered=len(recovered),
+                )
+                logger.warning(
+                    "router_ha: %s won the router lease at epoch %d "
+                    "(takeover; %d journaled request(s) to rebuild)",
+                    self.name, epoch, len(recovered),
+                )
+            else:
+                logger.warning(
+                    "router_ha: %s won the router lease at epoch 0 "
+                    "(first activation)", self.name,
+                )
+            for rid, st in recovered.items():
+                self._rebuild_one(rid, st)
+        finally:
+            with self._lock:
+                self._taking_over = False
+                # a lease lost DURING takeover leaves us fenced, not
+                # active — the winner of epoch+2 owns these requests now
+                if not self.fenced:
+                    self.active = True
+
+    def _rebuild_one(self, rid: str, st: Dict[str, Any]) -> None:
+        record = st["record"]
+        entry = self.wal.admit_recovered(
+            rid, record, st["tokens"], st["done"], st["error"]
+        )
+        if entry.done:
+            return  # servable for resume; nothing to regenerate
+        try:
+            kwargs: Dict[str, Any] = dict(
+                temperature=float(record.get("temperature", 0.0)),
+                top_p=float(record.get("top_p", 1.0)),
+                seed=int(record.get("seed", 0)),
+                block=True,
+                timeout=10.0,
+            )
+            if record.get("eos_id") is not None:
+                kwargs["eos_id"] = int(record["eos_id"])
+            if record.get("session"):
+                kwargs["session"] = str(record["session"])
+            if record.get("tenant") is not None:
+                kwargs["tenant"] = str(record["tenant"])
+            handle = self.fleet.submit(
+                [int(t) for t in record.get("prompt") or []],
+                int(record.get("max_new", 1)),
+                _resume_tokens=list(entry.tokens),
+                **kwargs,
+            )
+        except Exception as e:
+            logger.warning(
+                "router_ha: takeover resubmission of %r failed: %s",
+                rid, _first_line(e),
+            )
+            self.wal.fail(rid, e)
+            return
+        self.resumed_requests += 1
+        # binding also covers the instantly-complete resume (the prefix
+        # already covered the budget): _finish put the DONE sentinel in
+        # the handle's queue, so the pump settles the entry right away
+        self.wal.bind(entry, handle)
+        _flight.record(
+            "router_ha", "resume", router=self.name, rid=rid,
+            delivered=len(entry.tokens),
+        )
+
+    def _on_lease_lost(self, key: str, epoch: int, cur) -> None:
+        if key != ROUTER_LEASE_KEY:
+            return
+        with self._lock:
+            self.active = False
+            self.fenced = True
+        # fleet.router_epoch stays at the superseded value ON PURPOSE:
+        # any placement this zombie still makes carries the stale epoch
+        # and is rejected member-side (StaleRouterEpochError)
+        _flight.record(
+            "router_ha", "lease_lost", router=self.name, epoch=epoch,
+            holder=None if cur is None else cur.worker,
+        )
+        logger.warning(
+            "router_ha: %s lost the router lease at epoch %d (fenced; "
+            "admission stopped — a standby is taking over)",
+            self.name, epoch,
+        )
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    def statusz_view(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "active": self.active,
+            "fenced": self.fenced,
+            "epoch": self.epoch,
+            "lease_ttl_s": self.store.ttl_s,
+            "resumed_requests": self.resumed_requests,
+            "wal_enabled": enabled(),
+            "wal": self.wal.statusz_view(),
+        }
+
+    def stop(self) -> None:
+        """Stop journaling and heartbeating WITHOUT unlinking the
+        election lease: the epoch lineage must survive this process —
+        a successor acquires epoch+1 after the TTL, and unlinking would
+        reset epochs to 0 (breaking zombie fencing forever after)."""
+        self.wal.stop()
+        self.store.stop(unlink_held=False)
+
+
+def attach_router_ha(
+    fleet,
+    path: str,
+    *,
+    name: Optional[str] = None,
+    ttl_s: Optional[float] = None,
+) -> RouterHA:
+    """Wire router HA onto a fleet router (usually one built by
+    :func:`~tensorframes_tpu.serve.membership.connect_fleet` over the
+    same ``path``): creates the :class:`RouterHA` state machine,
+    exposes it (and its WAL tracker) to the serving layer as
+    ``fleet.router_ha`` / ``fleet.wal``, and registers the election
+    tick on the fleet watchdog. Requires ``Config.router_wal=True`` to
+    actually journal/dedupe/resume — attached-but-gated-off, the
+    serving path stays byte-identical to the pre-HA stack."""
+    ha = RouterHA(fleet, path, name=name, ttl_s=ttl_s)
+    fleet.router_ha = ha
+    fleet.wal = ha.wal
+    fleet._tick_hooks.append(ha.tick)
+    return ha

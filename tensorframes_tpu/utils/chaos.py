@@ -145,6 +145,8 @@ SITES = (
     "fleet.replica_fault",
     "fleet.member_heartbeat",
     "fleet.registry",
+    "fleet.router_wal",
+    "fleet.router_heartbeat",
     "tune.trial",
     "tenancy.admit",
 )

@@ -247,6 +247,23 @@ class Config:
     #: runtime via ``POST /admin/tenants``. See docs/serving_llm.md
     #: "Multi-tenancy".
     tenants: tuple = ()
+    #: master switch for the router's durable request plane
+    #: (``serve/router_ha.py``): the per-request WAL, request_id
+    #: dedupe/stream resume on ``POST /generate``, and standby
+    #: takeover resubmission. The FALSE default means the whole plane
+    #: is off — no WAL writes, no per-request tracker, streams
+    #: byte-identical to the pre-WAL serving path at zero per-token
+    #: cost (the on/off gate is a module global refreshed by the
+    #: set_config callback hook, the tenancy/chaos pattern). See
+    #: docs/fault_tolerance.md "Router HA".
+    router_wal: bool = False
+    #: TTL of the router-election lease (``serve/router_ha.py``): a
+    #: standby detects active-router death after at most this long and
+    #: takes over at epoch+1. Shorter than the member TTL — router
+    #: takeover is on the client-visible path where member fencing
+    #: already hides behind stream replay. Per-router override:
+    #: ``RouterHA(ttl_s=)``.
+    router_lease_ttl_s: float = 3.0
 
 
 _lock = threading.Lock()

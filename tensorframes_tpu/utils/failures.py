@@ -54,6 +54,7 @@ __all__ = [
     "PagePoolExhausted",
     "QuarantinedBlocksError",
     "StaleLeaseError",
+    "StaleRouterEpochError",
     "TenantThrottledError",
 ]
 
@@ -211,6 +212,21 @@ class StaleLeaseError(RuntimeError):
     byte-identical) or the journal is owned by someone alive. The
     remedy is to move on to the next block / wait for the drain, never
     to retry the fenced write."""
+
+
+class StaleRouterEpochError(StaleLeaseError):
+    """A serving member rejected a placement carrying a superseded
+    router epoch (``x-router-epoch`` below the router-election lease's
+    current epoch, ``serve/router_ha.py``): the placing router was
+    fenced and a standby took over at epoch+1, so this is a ZOMBIE
+    router's placement — admitting it would double-generate a request
+    the new active router already resubmitted from the WAL. A
+    :class:`StaleLeaseError` sibling on purpose: same meaning (*this
+    process does not own the shared state it is mutating*), same
+    non-transient classification, and the fleet's failover path treats
+    it as non-replayable — a fenced router retrying the same stale
+    epoch elsewhere is refused everywhere. HTTP maps it to ``409
+    Conflict`` (``interop/serving.py``)."""
 
 
 class TenantThrottledError(RuntimeError):

@@ -1,0 +1,1339 @@
+"""Router high availability: durable request WAL, resumable client
+streams, fenced standby takeover (serve/router_ha.py).
+
+PR 18's correctness bar (byte-identical streams through member kill -9)
+raised one tier again: now the ROUTER dies. The fast suite covers the
+WAL's journal discipline (torn tails, cross-epoch merge, dedupe,
+eviction), reconnect-resume byte-identity over real sockets, the
+election/takeover state machine in-process (two RouterHA instances over
+one shared directory), member-side zombie-epoch rejection, verbatim
+Retry-After passthrough, lease clock edges, and the subprocess
+provisioner; the slow soak spawns 2 router + 3 member subprocesses,
+kill -9s the active router under 16 concurrent streams with chaos on,
+and reconnects every client against the standby — byte-identical, zero
+lost or duplicated tokens — then wakes a SIGSTOPped ex-active to prove
+its late placement is epoch-rejected.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.models import TransformerLM
+from tensorframes_tpu.obs import metrics as obs_metrics
+from tensorframes_tpu.serve import GenerationEngine
+from tensorframes_tpu.serve.fleet import Fleet
+from tensorframes_tpu.serve.membership import (
+    LocalProcessProvisioner,
+    MemberAgent,
+    MemberRegistry,
+    RemoteEngine,
+    connect_fleet,
+)
+from tensorframes_tpu.serve.router_ha import (
+    ROUTER_LEASE_KEY,
+    RequestWAL,
+    RouterHA,
+    attach_router_ha,
+    router_epoch_from,
+)
+from tensorframes_tpu.interop.serving import ScoringServer
+from tensorframes_tpu.utils.config import set_config
+from tensorframes_tpu.utils.failures import (
+    StaleLeaseError,
+    StaleRouterEpochError,
+    TenantThrottledError,
+)
+from tensorframes_tpu.utils.leases import LeaseStore
+
+pytestmark = pytest.mark.ha
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return TransformerLM.init(0, VOCAB, d_model=16, n_heads=4, max_len=64)
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    yield
+    set_config(router_wal=False, chaos="")
+
+
+@pytest.fixture
+def wal_on():
+    set_config(router_wal=True)
+    yield
+
+
+def _solo(lm, prompt, n, **kw):
+    return lm.generate(np.asarray([prompt], np.int32), n, **kw)[
+        0, len(prompt):
+    ]
+
+
+def _wait_for(pred, timeout=20.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def _counter_value(name, **labels):
+    try:
+        return obs_metrics.registry().get(name).value(**labels)
+    except KeyError:
+        return 0.0
+
+
+def _engine(lm, name="m"):
+    return GenerationEngine(
+        lm, max_slots=4, page_size=4, num_pages=48, max_seq_len=64,
+        name=name,
+    )
+
+
+def _http(addr, method, path, body=None, headers=None):
+    """One raw HTTP exchange; returns (status, parsed body, headers)."""
+    host, _, port = addr.rpartition(":")
+    payload = b"" if body is None else json.dumps(body).encode()
+    extra = "".join(
+        f"{k}: {v}\r\n" for k, v in (headers or {}).items()
+    )
+    with socket.create_connection((host, int(port)), timeout=15) as c:
+        c.sendall(
+            (
+                f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+                f"Content-Length: {len(payload)}\r\n{extra}"
+                f"Connection: close\r\n\r\n"
+            ).encode() + payload
+        )
+        buf = b""
+        while True:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    head, _, raw = buf.partition(b"\r\n\r\n")
+    lines = head.split(b"\r\n")
+    status = int(lines[0].split(b" ", 2)[1])
+    hdrs = {}
+    for hline in lines[1:]:
+        k, _, v = hline.partition(b":")
+        hdrs[k.strip().lower().decode()] = v.strip().decode()
+    try:
+        parsed = json.loads(raw.decode())
+    except ValueError:
+        parsed = {}
+    return status, parsed, hdrs
+
+
+def _stream_req(addr, body, stop_after=None, timeout=15.0):
+    """Streaming POST /generate; returns ``(status, tokens, terminal)``.
+    ``stop_after=k`` tears the connection after k token lines (the
+    disconnecting-client drill; terminal comes back None). A connection
+    that dies under us (the router was killed) returns what was read
+    with terminal None instead of raising."""
+    host, _, port = addr.rpartition(":")
+    payload = json.dumps(dict(body, stream=True)).encode()
+    c = socket.create_connection((host, int(port)), timeout=timeout)
+    toks, terminal, status = [], None, 0
+    try:
+        c.sendall(
+            (
+                f"POST /generate HTTP/1.1\r\nHost: x\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n"
+            ).encode() + payload
+        )
+        f = c.makefile("rb")
+        status = int(f.readline().split(b" ", 2)[1])
+        while f.readline() not in (b"\r\n", b""):
+            pass
+        if status != 200:
+            raw = f.read()
+            try:
+                terminal = json.loads(raw.decode())
+            except ValueError:
+                terminal = {}
+            return status, toks, terminal
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line.decode())
+            if "t" in d:
+                toks.append(int(d["t"]))
+                if stop_after is not None and len(toks) >= stop_after:
+                    break
+            else:
+                terminal = d
+                break
+    except OSError:
+        pass
+    finally:
+        c.close()
+    return status, toks, terminal
+
+
+# ---------------------------------------------------------------------------
+# the WAL: journal discipline, tracker semantics
+# ---------------------------------------------------------------------------
+
+
+def _write_ledger(wal_dir, epoch, records):
+    os.makedirs(wal_dir, exist_ok=True)
+    path = os.path.join(wal_dir, f"wal.e{epoch:06d}.jsonl")
+    with open(path, "ab") as f:
+        for rec in records:
+            if isinstance(rec, bytes):
+                f.write(rec)  # raw bytes: the torn-tail drill
+            else:
+                f.write(json.dumps(rec).encode() + b"\n")
+    return path
+
+
+_REC = {"prompt": [1, 2, 3], "max_new": 8, "temperature": 0.0,
+        "top_p": 1.0, "seed": 0, "eos_id": None, "session": None,
+        "tenant": None, "deadline_s": None, "trace": None}
+
+
+class TestRequestWAL:
+    def test_recover_merges_epochs_and_skips_torn_tail(self, tmp_path):
+        wal_dir = str(tmp_path / "wal")
+        # epoch 0: admit + first 3 tokens, then a kill -9 torn tail
+        _write_ledger(wal_dir, 0, [
+            {"e": "admit", "rid": "r1", "rec": dict(_REC)},
+            {"e": "tok", "rid": "r1", "off": 0, "t": [5]},
+            {"e": "tok", "rid": "r1", "off": 1, "t": [6, 7]},
+            b'{"e": "tok", "rid": "r1", "off": 3, "t"',  # torn
+        ])
+        # epoch 1: re-journaled snapshot (overlapping offsets) + more
+        _write_ledger(wal_dir, 1, [
+            {"e": "admit", "rid": "r1", "rec": dict(_REC)},
+            {"e": "tok", "rid": "r1", "off": 0, "t": [5, 6, 7]},
+            {"e": "tok", "rid": "r1", "off": 3, "t": [8]},
+            {"e": "admit", "rid": "r2", "rec": dict(_REC)},
+            {"e": "err", "rid": "r2", "kind": "ValueError", "msg": "bad"},
+            # records for an admission never seen: ignored
+            {"e": "tok", "rid": "ghost", "off": 0, "t": [1]},
+        ])
+        wal = RequestWAL(str(tmp_path), router_id="r-test")
+        wal.epoch = 2  # recovering incarnation
+        state = wal.recover()
+        assert state["r1"]["tokens"] == [5, 6, 7, 8]
+        assert state["r1"]["done"] is False
+        assert state["r2"]["done"] is True
+        assert state["r2"]["error"] == ("ValueError", "bad")
+        assert "ghost" not in state
+
+    def test_recover_trusts_only_contiguous_prefix_on_gap(self, tmp_path):
+        wal_dir = str(tmp_path / "wal")
+        _write_ledger(wal_dir, 0, [
+            {"e": "admit", "rid": "g", "rec": dict(_REC)},
+            {"e": "tok", "rid": "g", "off": 0, "t": [1, 2]},
+            {"e": "tok", "rid": "g", "off": 5, "t": [9]},  # a gap
+        ])
+        wal = RequestWAL(str(tmp_path), router_id="r-test")
+        wal.epoch = 1
+        assert wal.recover()["g"]["tokens"] == [1, 2]
+
+    def test_recover_readmission_after_error_resets(self, tmp_path):
+        """A client retry of a refused id (forget() journaled the err
+        and freed it) re-admits fresh — recovery must follow the
+        RETRY's lifecycle, not merge into the refusal's."""
+        wal_dir = str(tmp_path / "wal")
+        _write_ledger(wal_dir, 0, [
+            {"e": "admit", "rid": "x", "rec": dict(_REC)},
+            {"e": "err", "rid": "x", "kind": "QueueFullError", "msg": "f"},
+            {"e": "admit", "rid": "x", "rec": dict(_REC)},
+            {"e": "tok", "rid": "x", "off": 0, "t": [4, 4]},
+            {"e": "done", "rid": "x", "n": 2},
+        ])
+        wal = RequestWAL(str(tmp_path), router_id="r-test")
+        wal.epoch = 1
+        st = wal.recover()["x"]
+        assert st == {"record": dict(_REC), "tokens": [4, 4],
+                      "done": True, "error": None}
+
+    def test_recover_ignores_own_and_future_epochs(self, tmp_path):
+        wal_dir = str(tmp_path / "wal")
+        _write_ledger(wal_dir, 3, [
+            {"e": "admit", "rid": "mine", "rec": dict(_REC)},
+        ])
+        wal = RequestWAL(str(tmp_path), router_id="r-test")
+        wal.epoch = 3
+        assert wal.recover() == {}
+
+    def test_admit_dedupes_and_forget_frees(self, tmp_path, wal_on):
+        wal = RequestWAL(str(tmp_path), router_id="r-test")
+        wal.open(0)
+        try:
+            e1, created1 = wal.admit("a", dict(_REC))
+            e2, created2 = wal.admit("a", dict(_REC))
+            assert created1 and not created2 and e1 is e2
+            wal.forget("a", QueueFullErrorStub("full"))
+            assert wal.lookup("a") is None
+            e3, created3 = wal.admit("a", dict(_REC))
+            assert created3 and e3 is not e1
+        finally:
+            wal.stop()
+
+    def test_journal_flushes_fsynced_records_and_counts(
+        self, tmp_path, wal_on
+    ):
+        before = {
+            ev: _counter_value("fleet.wal_records_total", event=ev)
+            for ev in ("admit", "done")
+        }
+        wal = RequestWAL(str(tmp_path), router_id="r-test")
+        wal.open(0)
+        try:
+            entry, _ = wal.admit("j1", dict(_REC))
+            wal._settle(entry, None)
+            ledger = os.path.join(str(tmp_path), "wal", "wal.e000000.jsonl")
+            _wait_for(
+                lambda: os.path.exists(ledger)
+                and len(open(ledger, "rb").read().splitlines()) >= 2,
+                what="writer thread flushing both records",
+            )
+            lines = [
+                json.loads(x)
+                for x in open(ledger, "rb").read().splitlines()
+            ]
+            assert [x["e"] for x in lines] == ["admit", "done"]
+            _wait_for(
+                lambda: (
+                    _counter_value("fleet.wal_records_total", event="admit")
+                    > before["admit"]
+                    and _counter_value(
+                        "fleet.wal_records_total", event="done"
+                    )
+                    > before["done"]
+                ),
+                what="wal record counters",
+            )
+        finally:
+            wal.stop()
+
+    def test_chaos_transient_on_flush_is_absorbed(self, tmp_path, wal_on):
+        set_config(chaos="fleet.router_wal=transient:p=1.0:times=2")
+        wal = RequestWAL(str(tmp_path), router_id="r-test")
+        wal.open(0)
+        try:
+            wal.admit("c1", dict(_REC))
+            ledger = os.path.join(str(tmp_path), "wal", "wal.e000000.jsonl")
+            _wait_for(
+                lambda: os.path.exists(ledger)
+                and b"admit" in open(ledger, "rb").read(),
+                what="flush surviving transient chaos",
+            )
+        finally:
+            wal.stop()
+            set_config(chaos="")
+
+    def test_eviction_drops_done_never_live(self, tmp_path, monkeypatch):
+        import tensorframes_tpu.serve.router_ha as rh
+
+        monkeypatch.setattr(rh, "_MAX_ENTRIES", 2)
+        wal = RequestWAL(str(tmp_path), router_id="r-test")
+        wal.open(0)
+        try:
+            live1, _ = wal.admit("live1", dict(_REC))
+            done1, _ = wal.admit("done1", dict(_REC))
+            wal._settle(done1, None)
+            live2, _ = wal.admit("live2", dict(_REC))  # exceeds the bound
+            assert wal.lookup("done1") is None  # evicted (oldest done)
+            assert wal.lookup("live1") is live1
+            assert wal.lookup("live2") is live2
+            wal.admit("live3", dict(_REC))  # nothing evictable: all live
+            assert wal.lookup("live1") and wal.lookup("live2")
+        finally:
+            wal.stop()
+
+
+class QueueFullErrorStub(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# gating: off by default, byte-identical off-path
+# ---------------------------------------------------------------------------
+
+
+class TestGating:
+    def test_off_by_default_and_rid_still_echoed(self, lm):
+        from tensorframes_tpu.utils.config import get_config
+
+        assert get_config().router_wal is False
+        fleet = Fleet(lm, replicas=1)
+        try:
+            assert getattr(fleet, "wal", None) is None
+            with ScoringServer(engine=fleet) as addr:
+                status, toks, term = _stream_req(
+                    addr,
+                    {"prompt": [3, 1, 2], "max_new_tokens": 6,
+                     "request_id": "cli-1"},
+                )
+                assert status == 200 and term.get("done")
+                # satellite: the client id is echoed even without a WAL
+                assert term["request_id"] == "cli-1"
+                np.testing.assert_array_equal(
+                    np.asarray(toks), _solo(lm, [3, 1, 2], 6)
+                )
+                # no journal, no dedupe: a duplicate id generates again
+                # (same bytes — determinism, not the tracker)
+                status2, toks2, _ = _stream_req(
+                    addr,
+                    {"prompt": [3, 1, 2], "max_new_tokens": 6,
+                     "request_id": "cli-1"},
+                )
+                assert status2 == 200 and toks2 == toks
+        finally:
+            fleet.stop()
+
+    def test_attached_but_config_off_stays_cold(self, lm, tmp_path):
+        fleet = Fleet(lm, replicas=1)
+        ha = attach_router_ha(fleet, str(tmp_path))
+        try:
+            ha.tick()
+            _wait_for(lambda: ha.active, what="first activation")
+            with ScoringServer(engine=fleet) as addr:
+                status, toks, term = _stream_req(
+                    addr,
+                    {"prompt": [2, 2], "max_new_tokens": 5,
+                     "request_id": "cold-1"},
+                )
+                assert status == 200 and term.get("done")
+                np.testing.assert_array_equal(
+                    np.asarray(toks), _solo(lm, [2, 2], 5)
+                )
+            # config off → nothing tracked, nothing journaled
+            assert fleet.wal.lookup("cold-1") is None
+            assert fleet.wal.records_written == 0
+        finally:
+            ha.stop()
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# resumable streams (in-process: real sockets, local fleet)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def ha_fleet(lm, tmp_path, wal_on):
+    fleet = Fleet(lm, replicas=2)
+    ha = attach_router_ha(fleet, str(tmp_path))
+    ha.tick()
+    _wait_for(lambda: ha.active, what="router activation")
+    server = ScoringServer(engine=fleet)
+    host, port = server.start()
+    yield fleet, ha, f"{host}:{port}"
+    server.stop()
+    ha.stop()
+    fleet.stop()
+
+
+class TestResumableStreams:
+    def test_fresh_stream_tracked_and_byte_identical(self, lm, ha_fleet):
+        fleet, ha, addr = ha_fleet
+        want = _solo(lm, [4, 5, 6], 8, temperature=0.7, seed=11)
+        status, toks, term = _stream_req(
+            addr,
+            {"prompt": [4, 5, 6], "max_new_tokens": 8,
+             "temperature": 0.7, "seed": 11, "request_id": "s-1"},
+        )
+        assert status == 200 and term.get("done")
+        assert term["request_id"] == "s-1"
+        np.testing.assert_array_equal(np.asarray(toks), want)
+        entry = fleet.wal.lookup("s-1")
+        assert entry is not None and entry.done
+        assert entry.tokens == [int(t) for t in want]
+
+    def test_duplicate_id_dedupes_nonstream(self, ha_fleet, lm):
+        fleet, ha, addr = ha_fleet
+        body = {"prompt": [1, 2, 3], "max_new_tokens": 6,
+                "request_id": "dup-1"}
+        before = _counter_value("serve.stream_resumes_total")
+        s1, b1, _ = _http(addr, "POST", "/generate", body)
+        s2, b2, _ = _http(addr, "POST", "/generate", body)
+        assert s1 == 200 and s2 == 200
+        assert b1["tokens"] == b2["tokens"]
+        assert b1["request_id"] == b2["request_id"] == "dup-1"
+        np.testing.assert_array_equal(
+            np.asarray(b1["tokens"]), _solo(lm, [1, 2, 3], 6)
+        )
+        assert _counter_value("serve.stream_resumes_total") - before == 1.0
+
+    def test_disconnect_reconnect_resumes_byte_identical(
+        self, lm, ha_fleet
+    ):
+        fleet, ha, addr = ha_fleet
+        want = _solo(lm, [7, 8], 10, temperature=0.5, seed=3)
+        before = _counter_value("serve.stream_resumes_total")
+        body = {"prompt": [7, 8], "max_new_tokens": 10,
+                "temperature": 0.5, "seed": 3, "request_id": "rc-1"}
+        # the client reads 4 tokens and its connection dies
+        status, head, term = _stream_req(addr, body, stop_after=4)
+        assert status == 200 and len(head) == 4 and term is None
+        # reconnect with from=<what it already has>: the missed tail
+        status, tail, term = _stream_req(
+            addr, dict(body, **{"from": len(head)})
+        )
+        assert status == 200 and term.get("done")
+        assert term["request_id"] == "rc-1"
+        np.testing.assert_array_equal(np.asarray(head + tail), want)
+        assert term["tokens_total"] == len(want)
+        assert (
+            _counter_value("serve.stream_resumes_total") - before == 1.0
+        )
+
+    def test_finished_stream_replays_fully_from_zero(self, lm, ha_fleet):
+        fleet, ha, addr = ha_fleet
+        body = {"prompt": [9, 1], "max_new_tokens": 7,
+                "request_id": "rp-1"}
+        status, first, term = _stream_req(addr, body)
+        assert status == 200 and term.get("done")
+        # long after completion: a replay of the whole stream
+        status, again, term2 = _stream_req(addr, dict(body, **{"from": 0}))
+        assert status == 200 and term2.get("done")
+        assert again == first
+        np.testing.assert_array_equal(
+            np.asarray(again), _solo(lm, [9, 1], 7)
+        )
+
+    def test_negative_from_is_a_400(self, ha_fleet):
+        fleet, ha, addr = ha_fleet
+        status, body, _ = _http(
+            addr, "POST", "/generate",
+            {"prompt": [1], "max_new_tokens": 2, "request_id": "neg",
+             "from": -1},
+        )
+        assert status == 400
+
+
+# ---------------------------------------------------------------------------
+# election, takeover, zombie fencing (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestElectionAndTakeover:
+    def test_single_active_standby_waits_then_takes_over(
+        self, lm, tmp_path, wal_on
+    ):
+        before = _counter_value("fleet.router_takeovers_total")
+        fa = Fleet(lm, replicas=1)
+        fb = Fleet(lm, replicas=1)
+        ha_a = RouterHA(fa, str(tmp_path), name="ra", ttl_s=1.0)
+        ha_b = RouterHA(fb, str(tmp_path), name="rb", ttl_s=1.0)
+        try:
+            ha_a.tick()
+            _wait_for(lambda: ha_a.active, what="ra active")
+            assert ha_a.epoch == 0 and fa.router_epoch == 0
+            ha_b.tick()
+            time.sleep(0.1)
+            assert not ha_b.active  # the lease is live: no takeover
+            # ra dies (no more renewals); rb campaigns past the TTL
+            ha_a.store.stop(unlink_held=False)
+            deadline = time.monotonic() + 20.0
+            while not ha_b.active and time.monotonic() < deadline:
+                ha_b._last_tick = -1e9  # defeat the tick rate limit
+                ha_b.tick()
+                time.sleep(0.05)
+            assert ha_b.active and ha_b.epoch == 1
+            assert fb.router_epoch == 1
+            assert (
+                _counter_value("fleet.router_takeovers_total") - before
+                == 1.0
+            )
+        finally:
+            ha_a.stop()
+            ha_b.stop()
+            fa.stop()
+            fb.stop()
+
+    def test_takeover_resumes_partial_request_byte_identical(
+        self, lm, tmp_path, wal_on
+    ):
+        """The tentpole fold: a previous incarnation journaled an
+        admission plus a delivered watermark and died; the new active
+        resubmits with the watermark as the handle's prefix and the
+        completed sequence is byte-identical to solo — greedy AND
+        seeded sampling (per-step keys fold at absolute positions)."""
+        # an expired epoch-0 lease so the takeover wins epoch 1
+        old = LeaseStore(
+            str(tmp_path), worker_id="dead-router", ttl_s=0.2
+        )
+        assert old.acquire(ROUTER_LEASE_KEY) == 0
+        old._stop.set()  # kill its heartbeat; the lease lapses
+        time.sleep(0.4)
+        cases = {
+            "greedy": ([5, 6, 7], 9, {}),
+            "seeded": ([2, 4], 10,
+                       {"temperature": 0.9, "top_p": 0.9, "seed": 21}),
+        }
+        wal_dir = str(tmp_path / "wal")
+        wants = {}
+        for rid, (prompt, n, kw) in cases.items():
+            want = [int(t) for t in _solo(lm, prompt, n, **kw)]
+            wants[rid] = want
+            rec = dict(
+                _REC, prompt=prompt, max_new=n,
+                temperature=kw.get("temperature", 0.0),
+                top_p=kw.get("top_p", 1.0), seed=kw.get("seed", 0),
+            )
+            _write_ledger(wal_dir, 0, [
+                {"e": "admit", "rid": rid, "rec": rec},
+                # 4 tokens delivered before the router died
+                {"e": "tok", "rid": rid, "off": 0, "t": want[:4]},
+            ])
+        fleet = Fleet(lm, replicas=2)
+        fleet.start()
+        ha = attach_router_ha(fleet, str(tmp_path), ttl_s=1.0)
+        try:
+            deadline = time.monotonic() + 20.0
+            while not ha.active and time.monotonic() < deadline:
+                ha._last_tick = -1e9
+                ha.tick()
+                time.sleep(0.05)
+            assert ha.active and ha.epoch == 1
+            assert ha.resumed_requests == 2
+            for rid, want in wants.items():
+                entry = fleet.wal.lookup(rid)
+                assert entry is not None
+                _wait_for(
+                    lambda e=entry: e.done, what=f"resumed {rid} settling"
+                )
+                assert entry.error is None
+                assert entry.tokens == want, rid
+        finally:
+            ha.stop()
+            fleet.stop()
+            old.stop(unlink_held=False)
+
+    def test_standby_router_serves_503(self, lm, tmp_path, wal_on):
+        # someone else holds the lease: this router stays standby
+        holder = LeaseStore(str(tmp_path), worker_id="other", ttl_s=30.0)
+        assert holder.acquire(ROUTER_LEASE_KEY) == 0
+        fleet = Fleet(lm, replicas=1)
+        ha = attach_router_ha(fleet, str(tmp_path), ttl_s=30.0)
+        try:
+            ha.tick()
+            time.sleep(0.1)
+            assert not ha.active
+            with ScoringServer(engine=fleet) as addr:
+                status, body, hdrs = _http(
+                    addr, "POST", "/generate",
+                    {"prompt": [1], "max_new_tokens": 2,
+                     "request_id": "sb"},
+                )
+                assert status == 503
+                assert body["kind"] == "RouterStandby"
+                assert body["request_id"] == "sb"
+                assert hdrs.get("retry-after") == "1"
+        finally:
+            ha.stop()
+            fleet.stop()
+            holder.stop(unlink_held=False)
+
+    def test_member_rejects_stale_router_epoch(self, lm, tmp_path):
+        """Member-side fencing: a 409 for a placement whose
+        x-router-epoch header is below the election lease's epoch, a
+        pass for the current epoch, and no fencing without a header."""
+        reg_dir = str(tmp_path)
+        engine = _engine(lm, "m0")
+        engine.start()
+        registry = MemberRegistry(reg_dir, worker_id="proc-m0", ttl_s=30.0)
+        agent = MemberAgent(engine, registry, "m0")
+        host, port = agent.start()
+        addr = f"{host}:{port}"
+        # the election lease sits at epoch 1 (someone took over once)
+        store = LeaseStore(reg_dir, worker_id="r-old", ttl_s=0.2)
+        assert store.acquire(ROUTER_LEASE_KEY) == 0
+        store._stop.set()
+        time.sleep(0.4)
+        store2 = LeaseStore(reg_dir, worker_id="r-new", ttl_s=30.0)
+        assert store2.acquire(ROUTER_LEASE_KEY) == 1
+        try:
+            body = {"prompt": [1, 2], "max_new_tokens": 3}
+            status, parsed, _ = _http(
+                addr, "POST", "/generate", body,
+                headers={"x-router-epoch": "0"},
+            )
+            assert status == 409
+            assert parsed["kind"] == "StaleRouterEpochError"
+            status, parsed, _ = _http(
+                addr, "POST", "/generate", body,
+                headers={"x-router-epoch": "1"},
+            )
+            assert status == 200 and len(parsed["tokens"]) == 3
+            status, parsed, _ = _http(addr, "POST", "/generate", body)
+            assert status == 200  # pre-HA clients are never fenced
+        finally:
+            agent.shutdown(timeout_s=5.0)
+            store.stop(unlink_held=False)
+            store2.stop(unlink_held=False)
+
+    def test_remote_engine_stamps_epoch_and_reraises_409(
+        self, lm, tmp_path
+    ):
+        """Router-side half: RemoteEngine sends the placing fleet's
+        epoch and maps the member's 409 back to the exception class —
+        which the fleet treats as non-replayable (no survivor retry of
+        a zombie's placement)."""
+        reg_dir = str(tmp_path)
+        engine = _engine(lm, "m0")
+        engine.start()
+        registry = MemberRegistry(reg_dir, worker_id="proc-m0", ttl_s=30.0)
+        agent = MemberAgent(engine, registry, "m0")
+        host, port = agent.start()
+        store = LeaseStore(reg_dir, worker_id="r-new", ttl_s=30.0)
+        assert store.acquire(ROUTER_LEASE_KEY) == 0
+        rem = RemoteEngine("m0", f"{host}:{port}")
+        rem.router_epoch_fn = lambda: -1  # always below the lease epoch
+        try:
+            with pytest.raises(StaleRouterEpochError):
+                rem.submit([1, 2], 3)
+            rem.router_epoch_fn = lambda: 0  # current: placement lands
+            h = rem.submit([1, 2], 3)
+            assert len(h.result(timeout=30)) == 3
+        finally:
+            agent.shutdown(timeout_s=5.0)
+            store.stop(unlink_held=False)
+
+    def test_epoch_reader_caches_and_degrades_to_none(self, tmp_path):
+        store = LeaseStore(str(tmp_path), worker_id="m", ttl_s=30.0)
+        reader = router_epoch_from(store, cache_s=0.05)
+        assert reader() is None  # no election lease yet
+        holder = LeaseStore(str(tmp_path), worker_id="r", ttl_s=30.0)
+        holder.acquire(ROUTER_LEASE_KEY)
+        assert reader() is None  # cached miss
+        time.sleep(0.08)
+        assert reader() == 0  # cache expired: the lease is visible
+        holder.stop(unlink_held=False)
+        store.stop(unlink_held=False)
+
+
+# ---------------------------------------------------------------------------
+# error-mapping fidelity: Retry-After and reason ride through verbatim
+# ---------------------------------------------------------------------------
+
+
+class _RefusingEngine:
+    """Duck-typed engine whose submit always refuses; _thread is
+    non-None so ScoringServer never tries to start it."""
+
+    def __init__(self, exc):
+        self.exc = exc
+        self._thread = threading.current_thread()
+
+    def submit(self, *a, **kw):
+        raise self.exc
+
+    def health(self):
+        return {"healthy": True}
+
+
+class TestRetryAfterFidelity:
+    def test_router_echoes_member_retry_after_verbatim_429(self):
+        e = TenantThrottledError(
+            "tenant t1 over quota", retry_after=7.0, reason="rate",
+            tenant="t1",
+        )
+        e.retry_after_hint = "7"  # what the member's header said
+        with ScoringServer(engine=_RefusingEngine(e)) as addr:
+            status, body, hdrs = _http(
+                addr, "POST", "/generate",
+                {"prompt": [1], "max_new_tokens": 2,
+                 "request_id": "q-1"},
+            )
+        assert status == 429
+        assert hdrs["retry-after"] == "7"  # verbatim, not recomputed
+        assert body["reason"] == "rate" and body["tenant"] == "t1"
+        assert body["retry_after"] == 7.0
+        assert body["request_id"] == "q-1"
+
+    def test_router_echoes_member_retry_after_verbatim_503(self):
+        from tensorframes_tpu.serve import EngineUnhealthyError
+
+        e = EngineUnhealthyError("member shedding")
+        e.retry_after_hint = "9"
+        with ScoringServer(engine=_RefusingEngine(e)) as addr:
+            status, body, hdrs = _http(
+                addr, "POST", "/generate",
+                {"prompt": [1], "max_new_tokens": 2},
+            )
+        assert status == 503 and hdrs["retry-after"] == "9"
+
+    def test_remote_engine_attaches_member_hint(self):
+        """End-to-end half: a member's 429 with Retry-After lands on
+        the router's exception as retry_after_hint with the throttle
+        reason and refill time intact."""
+        member_exc = TenantThrottledError(
+            "tenant t9 over quota", retry_after=13.0, reason="shed",
+            tenant="t9",
+        )
+        with ScoringServer(engine=_RefusingEngine(member_exc)) as addr:
+            rem = RemoteEngine("m0", addr)
+            with pytest.raises(TenantThrottledError) as ei:
+                rem.submit([1, 2], 3)
+        caught = ei.value
+        assert caught.retry_after_hint == "13"
+        assert caught.reason == "shed" and caught.tenant == "t9"
+        assert caught.retry_after == 13.0
+
+
+# ---------------------------------------------------------------------------
+# lease clock edges (utils/leases.py)
+# ---------------------------------------------------------------------------
+
+
+def _lease_file(tmp_path, key="k"):
+    d = os.path.join(str(tmp_path), "leases")
+    names = [n for n in os.listdir(d) if n.startswith(f"{key}.e")]
+    assert len(names) == 1, names
+    return os.path.join(d, names[0])
+
+
+def _rewrite_deadline(path, deadline_unix):
+    with open(path) as f:
+        d = json.load(f)
+    d["deadline_unix"] = deadline_unix
+    with open(path, "w") as f:
+        json.dump(d, f)
+
+
+class TestLeaseClockEdges:
+    def test_expiry_exactly_at_deadline_is_reclaimable(self, tmp_path):
+        """deadline_unix <= now reads EXPIRED (the holder must renew
+        BEFORE the deadline, not at it): a deadline pinned to 'now' is
+        reclaimable, a hair in the future is not."""
+        a = LeaseStore(str(tmp_path), worker_id="a", ttl_s=60.0)
+        assert a.acquire("k") == 0
+        a._stop.set()  # no renewals: the file's deadline is frozen
+        b = LeaseStore(str(tmp_path), worker_id="b", ttl_s=60.0)
+        _rewrite_deadline(_lease_file(tmp_path), time.time() + 30.0)
+        assert b.acquire("k") is None  # live
+        _rewrite_deadline(_lease_file(tmp_path), time.time())
+        assert b.acquire("k") == 1  # the exact-deadline edge: expired
+        a.stop(unlink_held=False)
+        b.stop(unlink_held=False)
+
+    def test_renewal_racing_expiry_loses_and_reports(self, tmp_path):
+        """The holder's renewal sweeps AFTER a reclaimer won epoch+1:
+        renew_all must drop the key (never resurrect the superseded
+        epoch file) and fire on_lost with the stale epoch."""
+        lost = []
+        a = LeaseStore(
+            str(tmp_path), worker_id="a", ttl_s=0.2, heartbeat_s=3600.0
+        )
+        a.on_lost = lambda key, epoch, cur: lost.append((key, epoch))
+        assert a.acquire("k") == 0
+        time.sleep(0.4)  # the lease lapses un-renewed
+        b = LeaseStore(str(tmp_path), worker_id="b", ttl_s=60.0)
+        assert b.acquire("k") == 1  # reclaimed
+        assert a.renew_all() == 0  # the race: renewal after the steal
+        assert lost == [("k", 0)]
+        with pytest.raises(StaleLeaseError):
+            a.publish("k", {"x": 1})
+        # the loser's sweep must not have resurrected epoch 0
+        assert b._scan("k").epoch == 1
+        a.stop(unlink_held=False)
+        b.stop(unlink_held=False)
+
+    def test_renewal_before_deadline_retains_ownership(self, tmp_path):
+        a = LeaseStore(
+            str(tmp_path), worker_id="a", ttl_s=0.6, heartbeat_s=3600.0
+        )
+        assert a.acquire("k") == 0
+        time.sleep(0.3)
+        assert a.renew_all() == 1  # fresh deadline mid-ttl
+        time.sleep(0.4)  # past the ORIGINAL deadline, not the renewed
+        b = LeaseStore(str(tmp_path), worker_id="b", ttl_s=60.0)
+        assert b.acquire("k") is None
+        a.stop(unlink_held=False)
+        b.stop(unlink_held=False)
+
+    def test_wall_clock_drift_semantics(self, tmp_path):
+        """Lease deadlines are WALL-clock (time.time()), shared via the
+        filesystem: a holder whose clock runs behind writes deadlines
+        that read as already-expired to a correct observer (reclaim —
+        availability over the drifted holder), and a clock running
+        ahead writes far-future deadlines that block reclaim until real
+        time catches up (safety: observers must not fence a live
+        holder on their own faster clock)."""
+        a = LeaseStore(str(tmp_path), worker_id="a", ttl_s=5.0)
+        assert a.acquire("k") == 0
+        a._stop.set()
+        b = LeaseStore(str(tmp_path), worker_id="b", ttl_s=5.0)
+        # holder clock 60s behind: its freshly-written deadline already
+        # reads expired here
+        _rewrite_deadline(_lease_file(tmp_path), time.time() - 55.0)
+        assert b.acquire("k") == 1
+        # holder clock 60s ahead: reclaim refused though its ttl is 5s
+        _rewrite_deadline(_lease_file(tmp_path), time.time() + 65.0)
+        c = LeaseStore(str(tmp_path), worker_id="c", ttl_s=5.0)
+        assert c.acquire("k") is None
+        a.stop(unlink_held=False)
+        b.stop(unlink_held=False)
+        c.stop(unlink_held=False)
+
+
+# ---------------------------------------------------------------------------
+# the local subprocess provisioner (real autoscaler actuation)
+# ---------------------------------------------------------------------------
+
+
+_SLEEP_SCRIPT = "import sys, time\nwhile True: time.sleep(0.2)\n"
+
+
+class TestLocalProcessProvisioner:
+    def test_spawn_bound_retire_and_stop(self, tmp_path):
+        prov = LocalProcessProvisioner(
+            str(tmp_path), _SLEEP_SCRIPT, base_name="u", max_procs=2,
+            term_grace_s=5.0,
+        )
+        try:
+            assert prov.scale_up() == "u-1"
+            assert prov.scale_up() == "u-2"
+            assert prov.alive == 2
+            assert prov.scale_up() is None  # the max_procs bound
+            # newest-first retirement
+            assert prov.scale_down() == "u-2"
+            _wait_for(lambda: prov.alive == 1, what="u-2 exiting")
+            assert prov.names() == ["u-1"]
+        finally:
+            prov.stop()
+        assert prov.alive == 0
+        assert prov.scale_down() is None  # nothing left to retire
+
+    def test_autoscaler_convenience_binds_callbacks(self, tmp_path):
+        prov = LocalProcessProvisioner(
+            str(tmp_path), _SLEEP_SCRIPT, max_procs=3
+        )
+
+        class _F:
+            replica_names = []
+            _tick_hooks = []
+
+        try:
+            sc = prov.autoscaler(
+                _F(), min_members=0, cooldown_s=0.0,
+                signals_fn=lambda: {
+                    "queue_depth": 99.0, "pages_frac": 0.0,
+                    "itl_p99_s": 0.0, "members": 0.0,
+                },
+            )
+            assert sc.max_members == 3
+            assert sc.evaluate(now=100.0) == "up"
+            assert prov.alive == 1
+        finally:
+            prov.stop()
+
+
+_PROV_MEMBER_SCRIPT = r"""
+import sys, time
+from tensorframes_tpu.models import TransformerLM
+from tensorframes_tpu.serve import GenerationEngine
+from tensorframes_tpu.serve.membership import MemberAgent, MemberRegistry
+
+reg_dir, name = sys.argv[1], sys.argv[2]
+lm = TransformerLM.init(0, 32, d_model=16, n_heads=4, max_len=64)
+eng = GenerationEngine(
+    lm, max_slots=4, page_size=4, num_pages=48, max_seq_len=64, name=name
+)
+eng.start()
+agent = MemberAgent(
+    eng, MemberRegistry(reg_dir, worker_id=f"proc-{name}", ttl_s=8.0), name
+)
+agent.start()
+agent.install_sigterm()
+while True:
+    time.sleep(0.05)
+"""
+
+
+@pytest.mark.slow
+class TestProvisionerScaleSoak:
+    def test_scale_up_then_graceful_down_through_the_roster(
+        self, lm, tmp_path
+    ):
+        """The ROADMAP item-3 remainder closed: the autoscaler's
+        callbacks actuate REAL MemberAgent subprocesses — scale-up
+        registers a serving member the router places work on; scale-down
+        SIGTERMs it and the member drains + resigns (terminal lease),
+        leaving the roster clean."""
+        reg_dir = str(tmp_path / "reg")
+        os.makedirs(reg_dir, exist_ok=True)
+        prov = LocalProcessProvisioner(
+            reg_dir, _PROV_MEMBER_SCRIPT, base_name="auto", max_procs=2,
+            env={"JAX_PLATFORMS": "cpu"}, term_grace_s=60.0,
+        )
+        fleet = None
+        try:
+            fleet = connect_fleet(
+                reg_dir, worker_id="router", ttl_s=8.0,
+                sync_interval_s=0.1, watchdog_interval_s=0.05,
+            )
+            fleet.start()
+            assert prov.scale_up() is not None
+            _wait_for(
+                lambda: len(fleet.replica_names) == 1, timeout=90,
+                what="provisioned member joining the roster",
+            )
+            name = fleet.replica_names[0]
+            got = np.asarray(
+                fleet.submit([3, 1, 2], 6, temperature=0.3, seed=9)
+                .result(timeout=120)
+            )
+            np.testing.assert_array_equal(
+                got, _solo(lm, [3, 1, 2], 6, temperature=0.3, seed=9)
+            )
+            assert prov.scale_up() is not None
+            _wait_for(
+                lambda: len(fleet.replica_names) == 2, timeout=90,
+                what="second member joining",
+            )
+            # scale down: SIGTERM → drain → resign → leave the roster
+            retired = prov.scale_down()
+            assert retired is not None
+            _wait_for(
+                lambda: len(fleet.replica_names) == 1, timeout=90,
+                what="retired member leaving the roster",
+            )
+            _wait_for(lambda: prov.alive == 1, timeout=90,
+                      what="retired process exiting")
+            views = {v.key: v for v in fleet.registry.members()}
+            assert views[retired].terminal  # resigned, not expired
+            assert name in fleet.replica_names or retired != name
+        finally:
+            prov.stop()
+            if fleet is not None:
+                fleet.stop()
+                fleet.registry.stop(unlink_held=False)
+
+
+# ---------------------------------------------------------------------------
+# statusz surfaces the router block
+# ---------------------------------------------------------------------------
+
+
+class TestStatusz:
+    def test_router_block_present_when_attached(self, lm, ha_fleet):
+        fleet, ha, addr = ha_fleet
+        status, body, _ = _http(addr, "GET", "/statusz")
+        assert status == 200
+        router = body["router"]
+        assert router["active"] is True and router["epoch"] == 0
+        assert router["wal_enabled"] is True
+        assert router["wal"]["epoch"] == 0
+
+    def test_router_block_none_without_ha(self, lm):
+        fleet = Fleet(lm, replicas=1)
+        try:
+            with ScoringServer(engine=fleet) as addr:
+                status, body, _ = _http(addr, "GET", "/statusz")
+            assert status == 200 and body["router"] is None
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance soak: 2 router + 3 member subprocesses, kill -9 the
+# active router mid-stream, SIGSTOP/CONT the successor for the zombie
+# drill
+# ---------------------------------------------------------------------------
+
+
+_MEMBER_SCRIPT = r"""
+import sys, time
+from tensorframes_tpu.models import TransformerLM
+from tensorframes_tpu.serve import GenerationEngine
+from tensorframes_tpu.serve.membership import MemberAgent, MemberRegistry
+
+reg_dir, name, ttl = sys.argv[1], sys.argv[2], float(sys.argv[3])
+lm = TransformerLM.init(0, 32, d_model=16, n_heads=4, max_len=64)
+eng = GenerationEngine(
+    lm, max_slots=8, page_size=4, num_pages=96, max_seq_len=64, name=name
+)
+eng.start()
+agent = MemberAgent(
+    eng, MemberRegistry(reg_dir, worker_id=f"proc-{name}", ttl_s=ttl), name
+)
+agent.start()
+agent.install_sigterm()
+while True:
+    time.sleep(0.05)
+"""
+
+_ROUTER_SCRIPT = r"""
+import json, os, sys, time
+from tensorframes_tpu.interop.serving import ScoringServer
+from tensorframes_tpu.serve.membership import connect_fleet
+from tensorframes_tpu.serve.router_ha import attach_router_ha
+from tensorframes_tpu.utils.config import set_config
+
+reg_dir, name, report = sys.argv[1], sys.argv[2], sys.argv[3]
+set_config(router_wal=True)
+fleet = connect_fleet(
+    reg_dir, worker_id=name, ttl_s=8.0,
+    sync_interval_s=0.1, watchdog_interval_s=0.05,
+)
+ha = attach_router_ha(fleet, reg_dir, name=name, ttl_s=2.0)
+fleet.start()
+srv = ScoringServer(engine=fleet, max_connections=32)
+host, port = srv.start()
+with open(report + ".tmp", "w") as f:
+    json.dump({"addr": f"{host}:{port}"}, f)
+os.rename(report + ".tmp", report)
+zreport = report + ".zombie"
+reported = False
+while True:
+    time.sleep(0.05)
+    if not reported and ha.fenced:
+        out = {"fenced": True}
+        try:
+            h = fleet.submit([1, 2, 3], 3, block=False)
+            h.result(timeout=15)
+            err = h.error
+            out["zombie_rejected"] = (
+                type(err).__name__ == "StaleRouterEpochError"
+            )
+        except Exception as e:
+            out["zombie_rejected"] = isinstance(
+                e, Exception
+            ) and "StaleRouterEpoch" in type(e).__name__
+        with open(zreport + ".tmp", "w") as f:
+            json.dump(out, f)
+        os.rename(zreport + ".tmp", zreport)
+        reported = True
+"""
+
+
+def _spawn(script, args, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-c", script, *args], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _read_report(path, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        time.sleep(0.1)
+    pytest.fail(f"report {path} never appeared")
+
+
+def _resilient_stream(addrs, body, rid, timeout=240.0):
+    """Drive one stream to completion across router deaths: reconnect
+    with request_id + from=<delivered> against whichever router
+    answers. Returns (tokens, terminal)."""
+    got = []
+    deadline = time.monotonic() + timeout
+    i = 0
+    while time.monotonic() < deadline:
+        addr = addrs[i % len(addrs)]
+        i += 1
+        req = dict(body, request_id=rid, **{"from": len(got)})
+        try:
+            status, toks, term = _stream_req(addr, req, timeout=10.0)
+        except OSError:
+            time.sleep(0.25)
+            continue
+        if status in (503, 409) or status == 0:
+            time.sleep(0.25)  # standby / fenced / no answer: rotate
+            continue
+        assert status == 200, (status, term)
+        got.extend(toks)
+        if term is not None:
+            if term.get("done"):
+                return got, term
+            pytest.fail(f"stream {rid} errored: {term}")
+        # torn mid-stream (the router died): loop reconnects
+    pytest.fail(f"stream {rid} never finished")
+
+
+@pytest.mark.slow
+class TestRouterHASoak:
+    def test_kill9_takeover_streams_resume_zombie_fenced(
+        self, lm, tmp_path
+    ):
+        """The acceptance drill. Two routers (WAL on) + three members;
+        16 concurrent client streams with transient chaos on members
+        and the router WAL; kill -9 the ACTIVE router mid-stream — the
+        standby takes over (epoch+1), resubmits the journaled requests
+        recompute-style, and every client finishes byte-identical to
+        solo by reconnecting with request_id + from (zero lost, zero
+        duplicated tokens). Then a SIGSTOPped successor sleeps through
+        its TTL, a fresh standby takes over, and the woken zombie's own
+        late placement is rejected member-side (StaleRouterEpochError,
+        reported from inside the zombie process)."""
+        reg_dir = str(tmp_path / "reg")
+        os.makedirs(reg_dir)
+        decode_lag = "serve.decode_step=latency:ms=15"
+        wal_chaos = "fleet.router_wal=transient:p=0.1"
+        members = {
+            name: _spawn(
+                _MEMBER_SCRIPT, [reg_dir, name, "8.0"],
+                extra_env={"TFT_CHAOS": f"seed={i + 1};{decode_lag}"},
+            )
+            for i, name in enumerate(["m0", "m1", "m2"])
+        }
+        r1_report = str(tmp_path / "r1.json")
+        r2_report = str(tmp_path / "r2.json")
+        routers = {
+            "r1": _spawn(
+                _ROUTER_SCRIPT, [reg_dir, "r1", r1_report],
+                extra_env={"TFT_CHAOS": f"seed=7;{wal_chaos}"},
+            ),
+        }
+        try:
+            r1_addr = _read_report(r1_report)["addr"]
+
+            # wait for the members to join and r1 to win the election
+            def _ready():
+                try:
+                    status, body, _ = _http(r1_addr, "GET", "/statusz")
+                except OSError:
+                    return False
+                router = body.get("router") or {}
+                fleetv = body.get("serving") or {}
+                return (
+                    status == 200
+                    and router.get("active") is True
+                    and len(fleetv.get("replicas") or []) == 3
+                )
+
+            _wait_for(_ready, timeout=120, what="r1 active over 3 members")
+            # the standby comes up AFTER r1 owns the lease
+            routers["r2"] = _spawn(
+                _ROUTER_SCRIPT, [reg_dir, "r2", r2_report],
+                extra_env={"TFT_CHAOS": f"seed=8;{wal_chaos}"},
+            )
+            r2_addr = _read_report(r2_report)["addr"]
+            addrs = [r1_addr, r2_addr]
+
+            rng = np.random.default_rng(23)
+            reqs = []
+            for i in range(16):
+                prompt = rng.integers(1, VOCAB, size=3 + i % 4).tolist()
+                kw = (
+                    {}
+                    if i % 3 == 0
+                    else {"temperature": 0.8, "seed": 50 + i}
+                )
+                reqs.append((prompt, 12, kw))
+            want = [_solo(lm, p, n, **kw) for p, n, kw in reqs]
+
+            results = [None] * 16
+            errors = []
+
+            def run_client(i):
+                p, n, kw = reqs[i]
+                body = {
+                    "prompt": p, "max_new_tokens": n,
+                    "session": f"s{i % 5}", **kw,
+                }
+                try:
+                    toks, term = _resilient_stream(
+                        addrs, body, rid=f"req-{i}"
+                    )
+                    results[i] = (toks, term)
+                except BaseException as e:  # pytest.fail raises
+                    errors.append((i, repr(e)))
+
+            threads = [
+                threading.Thread(target=run_client, args=(i,), daemon=True)
+                for i in range(16)
+            ]
+            for i, t in enumerate(threads):
+                t.start()
+                time.sleep(0.1)
+                if i == 7:
+                    # kill -9 the ACTIVE router with streams in flight
+                    routers["r1"].kill()
+            for t in threads:
+                t.join(timeout=300)
+            assert not errors, errors
+            assert all(r is not None for r in results)
+            for i, ((toks, term), w) in enumerate(zip(results, want)):
+                np.testing.assert_array_equal(
+                    np.asarray(toks), np.asarray(w), err_msg=f"req-{i}"
+                )
+                assert term["request_id"] == f"req-{i}"
+                assert term["tokens_total"] == len(w)
+
+            # r2 must have taken over at epoch 1
+            status, body, _ = _http(r2_addr, "GET", "/statusz")
+            assert status == 200
+            assert body["router"]["active"] is True
+            assert body["router"]["epoch"] >= 1
+
+            # --- the zombie drill: SIGSTOP r2 past its TTL, let a fresh
+            # standby win, then wake r2 and watch its placement bounce
+            r3_report = str(tmp_path / "r3.json")
+            routers["r3"] = _spawn(
+                _ROUTER_SCRIPT, [reg_dir, "r3", r3_report],
+            )
+            r3_addr = _read_report(r3_report)["addr"]
+            routers["r2"].send_signal(signal.SIGSTOP)
+            try:
+
+                def _r3_active():
+                    try:
+                        s, b, _ = _http(r3_addr, "GET", "/statusz")
+                    except OSError:
+                        return False
+                    return (
+                        s == 200
+                        and (b.get("router") or {}).get("active") is True
+                    )
+
+                _wait_for(
+                    _r3_active, timeout=120,
+                    what="r3 taking over from the stopped r2",
+                )
+            finally:
+                routers["r2"].send_signal(signal.SIGCONT)
+            zombie = _read_report(r2_report + ".zombie", timeout=120)
+            assert zombie == {"fenced": True, "zombie_rejected": True}
+
+            # the new active still serves byte-identically
+            status, toks, term = _stream_req(
+                r3_addr,
+                {"prompt": [9, 9, 2], "max_new_tokens": 6,
+                 "temperature": 0.4, "seed": 5, "request_id": "post"},
+                timeout=60.0,
+            )
+            assert status == 200 and term.get("done")
+            np.testing.assert_array_equal(
+                np.asarray(toks),
+                _solo(lm, [9, 9, 2], 6, temperature=0.4, seed=5),
+            )
+        finally:
+            for proc in list(routers.values()) + list(members.values()):
+                if proc.poll() is None:
+                    try:
+                        proc.send_signal(signal.SIGCONT)
+                    except OSError:
+                        pass
+                    proc.kill()
+                    proc.wait(timeout=30)
