@@ -6,7 +6,7 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-elastic test-fleet test-ha test-multihost test-obs test-obsfleet test-plan test-spec test-tenancy test-tp test-tune soak verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
+.PHONY: test test-failfast test-fast test-attn test-chaos test-distjobs test-durability test-elastic test-fleet test-ha test-multihost test-obs test-obsfleet test-plan test-spec test-tenancy test-tiers test-tp test-tune soak verify bench bench-serve bench-attn bench-jobs bench-ingest bench-pipeline bench-autotune bench-check bench-check-update bench-all bench-attention dryrun install lint
 
 install:
 	$(PY) -m pip install -e . --no-build-isolation
@@ -134,12 +134,20 @@ test-elastic:
 test-ha:
 	$(PY) -m pytest tests/ -q -m ha
 
+# the disaggregated-tier suite (serve/tiers.py + the fleet's tier-aware
+# router: live KV-page migration byte-identity matrix — greedy/seeded ×
+# TP degree × speculative × prefix-cache donors — first-token handoff,
+# pool-pressure rebalance vs preemption, chaos at tier.handoff /
+# fleet.migrate; incl. the slow-marked kill -9 mid-migration soak)
+test-tiers:
+	$(PY) -m pytest tests/ -q -m tiers
+
 # every multi-process fault-tolerance soak in one command: the elastic
 # membership, fleet failover, chaos, and router-HA suites INCLUDING
 # their slow-marked subprocess drills — the pre-release confidence run
 # (budget ~15 min; tier-1 stays the fast gate)
 soak:
-	$(PY) -m pytest tests/ -q -m "elastic or fleet or chaos or ha"
+	$(PY) -m pytest tests/ -q -m "elastic or fleet or chaos or ha or tiers"
 
 # just the real 2-process distributed suite
 test-multihost:

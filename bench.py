@@ -573,6 +573,123 @@ def _serve_tenants_mix(lm, plen, max_new, seed, per_class=6):
         tft.utils.set_config(tenants=())
 
 
+def _serve_tiers_leg(lm, tiers, workload, seed, max_seq_len):
+    """One leg of the ``TFT_BENCH_TIERS`` A/B: a two-replica fleet —
+    monolithic (``tiers=None``: both replicas ``mixed``) or 1+1
+    disaggregated (``("prefill", "decode")``: live KV-page handoff at
+    first token, serve/tiers.py) — serving the same mixed
+    prompt-heavy/decode-heavy workload. Consumer-side stamps give TTFT
+    and inter-token percentiles; migration count and latency are read
+    as metric deltas around the timed window."""
+    import threading
+
+    from tensorframes_tpu.obs import metrics as tft_metrics
+    from tensorframes_tpu.serve import Fleet
+
+    def _migration_counts():
+        snap = tft_metrics.snapshot()
+        mig = snap.get("serve.kv_migrations_total", {}).get("values", {})
+        hist = (
+            snap.get("serve.migration_seconds", {})
+            .get("values", {})
+            .get("", {})
+        )
+        return (
+            sum(mig.values()),
+            float(hist.get("sum", 0.0)),
+            int(hist.get("count", 0)),
+        )
+
+    fleet = Fleet(
+        lm,
+        replicas=2,
+        tiers=tiers,
+        max_slots=len(workload),
+        page_size=16,
+        max_seq_len=max_seq_len,
+        queue_capacity=len(workload),
+    )
+    stamps = [[] for _ in workload]
+
+    def consume(i, handle):
+        for _ in handle:
+            stamps[i].append(time.perf_counter())
+
+    with fleet:
+        warm = [
+            eng.submit([1, 2, 3], 2, block=False) for eng in fleet.engines
+        ]
+        for h in warm:
+            h.result(timeout=600)
+        mig0, mig_s0, mig_n0 = _migration_counts()
+        t0 = time.perf_counter()
+        handles = [
+            fleet.submit(p, n, seed=seed + i)
+            for i, (p, n) in enumerate(workload)
+        ]
+        threads = [
+            threading.Thread(target=consume, args=(i, h))
+            for i, h in enumerate(handles)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        mig1, mig_s1, mig_n1 = _migration_counts()
+        programs = fleet.program_counts()
+    total = sum(len(s) for s in stamps)
+    gaps = sorted(b - a for s in stamps for a, b in zip(s, s[1:]))
+    ttfts = sorted(s[0] - t0 for s in stamps if s)
+    out = {
+        "tokens_per_sec": round(total / dt, 1),
+        "ttft_p50_ms": round(_pct(ttfts, 0.50) * 1e3, 3),
+        "ttft_p99_ms": round(_pct(ttfts, 0.99) * 1e3, 3),
+        "itl_p50_ms": round(_pct(gaps, 0.50) * 1e3, 3),
+        "itl_p99_ms": round(_pct(gaps, 0.99) * 1e3, 3),
+        "wall_s": round(dt, 3),
+        "migrations": int(mig1 - mig0),
+        "compiled_step_programs": programs,
+    }
+    if mig_n1 > mig_n0:
+        out["migration_mean_ms"] = round(
+            (mig_s1 - mig_s0) / (mig_n1 - mig_n0) * 1e3, 3
+        )
+    return out
+
+
+def _serve_tiers_mix(lm, seed=20):
+    """The disaggregated-tier axis (``TFT_BENCH_TIERS``, ISSUE 20): the
+    SAME mixed load — prompt-heavy requests (long prefill, short
+    decode) interleaved with decode-heavy ones (short prefill, long
+    decode) — through a monolithic two-replica fleet vs a 1+1
+    prefill/decode tiered one. The tiered leg prefills every request on
+    the prefill replica and migrates its KV pages to the decode replica
+    at first token, so prompt-heavy prefill bursts stop preempting the
+    decode-heavy streams' step loop; the axis reports the numbers that
+    move (TTFT p50/p99, aggregate tok/s) plus migration count and mean
+    latency, monolithic first so regressions read as a pair."""
+    rng = np.random.default_rng(seed)
+    workload = []
+    for i in range(4):  # prompt-heavy: 384-token prefill, 16 new
+        workload.append(
+            (rng.integers(1, 256, size=384).astype(np.int32).tolist(), 16)
+        )
+    for i in range(8):  # decode-heavy: 32-token prefill, 96 new
+        workload.append(
+            (rng.integers(1, 256, size=32).astype(np.int32).tolist(), 96)
+        )
+    out = {}
+    for label, tiers in (
+        ("monolithic", None),
+        ("tiered_1p1d", ("prefill", "decode")),
+    ):
+        out[label] = _serve_tiers_leg(
+            lm, tiers, workload, seed=seed, max_seq_len=448
+        )
+    return out
+
+
 def _serve_tp_level(lm, degree, plen, max_new, seed, n_requests=16):
     """One tensor-parallel degree of the ``TFT_BENCH_TP`` axis: the
     concurrency-16 serving workload with ONE engine spanning ``degree``
@@ -779,6 +896,16 @@ def main_decode_serve():
     tenants = {}
     if os.environ.get("TFT_BENCH_TENANTS", "").strip():
         tenants = _serve_tenants_mix(lm, plen=plen, max_new=32, seed=17)
+    # the disaggregated-tier axis (ISSUE 20): mixed prompt-heavy/
+    # decode-heavy load through a monolithic two-replica fleet vs a 1+1
+    # prefill/decode tiered one with live KV-page handoff
+    # (serve/tiers.py) — TTFT p50/p99 + tok/s + migration count/latency
+    # per leg. TFT_BENCH_TIERS opts IN (default off, and the
+    # bench-check gate pins it off: the gated headline measures the
+    # untiered path, which is also the byte-identity baseline).
+    tiers = {}
+    if os.environ.get("TFT_BENCH_TIERS", "").strip():
+        tiers = _serve_tiers_mix(lm, seed=20)
     from tensorframes_tpu.utils import chaos
 
     print(
@@ -804,6 +931,7 @@ def main_decode_serve():
                     "speculative": speculative,
                     "observability": observability,
                     "tenants": tenants,
+                    "tiers": tiers,
                     # a chaos-tainted number must never be mistaken for a
                     # clean one (the injection sites sit on this path; the
                     # disabled check is the measured-as-free case)

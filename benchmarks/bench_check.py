@@ -35,6 +35,20 @@ Usage::
 ``--update`` reruns both benches and rewrites the ``bench_gate`` block
 (do this when a PR legitimately moves the numbers — the diff then
 documents the move).
+
+**Relative A/B mode** (``TFT_BENCH_GATE_RELATIVE=1``): recorded
+absolute numbers go stale the moment the gate runs on a different
+machine class than the one that recorded them (the PR 16 machine-drift
+incident: ``map_rows_journaled`` read −55% at pristine HEAD). In
+relative mode the gate ignores the recorded values entirely and runs
+each config TWICE in the same invocation on the same box: leg A under
+the pinned feature-off environment, leg B under the same pins except
+any ``TFT_*`` variable the caller set explicitly (so
+``TFT_BENCH_GATE_RELATIVE=1 TFT_BENCH_TIERS=1 make bench-check``
+measures feature-off vs feature-on back to back). Leg B must land
+within the same tolerance band of leg A. With no caller overrides the
+two legs are identical and the run measures pure machine noise — a
+cheap way to calibrate ``tolerance_pct`` for a new host class.
 """
 
 from __future__ import annotations
@@ -85,6 +99,11 @@ GATE_ENV = {
     # taint the gated numbers — `make bench-serve` measures the export
     # axis explicitly
     "TFT_TELEMETRY_DIR": "",
+    # the disaggregated-tier axis (TFT_BENCH_TIERS, ISSUE 20) pinned
+    # OFF: the gated headline measures the untiered single-engine
+    # decode path; the tiered-vs-monolithic A/B is an explicit opt-in
+    # (`make bench-serve` / TFT_BENCH_GATE_RELATIVE legs)
+    "TFT_BENCH_TIERS": "",
     "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
 }
 
@@ -185,7 +204,68 @@ def update() -> int:
     return 0
 
 
+def check_relative() -> int:
+    """Same-run A/B gate (``TFT_BENCH_GATE_RELATIVE=1``): leg A under
+    the pinned feature-off env, leg B with the caller's explicit
+    ``TFT_*`` overrides layered on top, compared within the recorded
+    tolerance band. No dependence on recorded absolute numbers — both
+    legs run on this box, this invocation."""
+    base = _load_baseline()
+    gate = base.get("bench_gate") or {}
+    env_a = dict(GATE_ENV)
+    env_a.update(gate.get("env", {}))
+    env_b = dict(env_a)
+    overrides = {
+        k: os.environ[k]
+        for k in env_a
+        if k.startswith("TFT_") and k in os.environ
+    }
+    env_b.update(overrides)
+    print(
+        "[bench-check] relative A/B mode: leg B overrides "
+        f"{overrides or '(none — measuring machine noise)'}",
+        flush=True,
+    )
+    failures = []
+    for config, metric in CONFIGS:
+        tol = _tolerance_for(metric, gate)
+        print(f"[bench-check] running {config} (leg A, pinned) ...",
+              flush=True)
+        ref = _run_bench(config, env_a)
+        print(f"[bench-check] running {config} (leg B, overrides) ...",
+              flush=True)
+        result = _run_bench(config, env_b)
+        fresh, baseline = float(result["value"]), float(ref["value"])
+        floor = baseline * (1.0 - tol / 100.0)
+        delta_pct = (fresh - baseline) / baseline * 100.0
+        verdict = "ok" if fresh >= floor else "REGRESSION"
+        print(
+            f"[bench-check]   {metric}: B={fresh:.1f} A={baseline:.1f} "
+            f"({delta_pct:+.1f}%, floor {floor:.1f} at -{tol:.0f}%) "
+            f"-> {verdict}",
+            flush=True,
+        )
+        if fresh < floor:
+            failures.append((metric, fresh, baseline, delta_pct))
+    if failures:
+        sys.stderr.write(
+            "bench-check (relative) FAILED: "
+            + "; ".join(
+                f"{m} leg B {f:.1f} vs leg A {b:.1f} ({d:+.1f}%)"
+                for m, f, b, d in failures
+            )
+            + "\n"
+        )
+        return 1
+    print("[bench-check] relative A/B within tolerance")
+    return 0
+
+
 def check() -> int:
+    if os.environ.get("TFT_BENCH_GATE_RELATIVE", "").strip() not in (
+        "", "0",
+    ):
+        return check_relative()
     base = _load_baseline()
     gate = base.get("bench_gate")
     if not gate or not gate.get("metrics"):

@@ -751,6 +751,9 @@ class ScoringServer:
           the mesh at a glance (ISSUE 14);
         - ``router``: router-HA election + WAL state when a
           ``RouterHA`` is attached (``serve/router_ha.py``);
+        - ``tiers``: replica tier roles and live KV-migration totals
+          when the fleet is disaggregated (``serve/tiers.py``; None
+          for an untiered topology);
         - ``trace_sink``: whether a JSONL span sink is attached.
 
         Always 200; rendering reads only lock-light engine counters
@@ -838,6 +841,11 @@ class ScoringServer:
             # the WAL tracker's depth — the first place to look after a
             # takeover drill
             "router": self._router_view(),
+            # disaggregated tiers (serve/tiers.py; None on an untiered
+            # engine/fleet): replica roles plus live KV-migration
+            # totals by reason — the first place to look when TTFT or
+            # inter-token latency moves after a re-tiering
+            "tiers": self._tiers_view(),
         }
         return "200 OK", json.dumps(payload, default=str).encode(
             "utf-8"
@@ -864,6 +872,31 @@ class ScoringServer:
             return None
         try:
             return ha.statusz_view()
+        except Exception as e:  # pragma: no cover - defensive
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _tiers_view(self):
+        """The disaggregated-tier ``/statusz`` block (None when the
+        engine is not a fleet, or when every replica is ``mixed`` —
+        the monolithic topology has nothing tier-shaped to report);
+        exceptions degrade to an ``"error"`` stub — the status page
+        always renders."""
+        reps = getattr(self._engine, "_replicas", None)
+        if reps is None:
+            return None
+        try:
+            roles = {
+                rep.name: getattr(rep, "tier", "mixed") for rep in reps
+            }
+            if all(t == "mixed" for t in roles.values()):
+                return None
+            from ..obs import metrics as _metrics
+
+            snap = _metrics.snapshot().get("serve.kv_migrations_total", {})
+            return {
+                "replicas": roles,
+                "migrations": dict(snap.get("values", {})),
+            }
         except Exception as e:  # pragma: no cover - defensive
             return {"error": f"{type(e).__name__}: {e}"}
 

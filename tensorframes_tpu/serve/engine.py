@@ -2093,6 +2093,34 @@ class GenerationEngine:
         with self._step_lock:
             return self._defragment_locked()
 
+    # -- live slot migration (serve/tiers.py) ------------------------------
+
+    def detach_slot(self, request_id: int, reason: str = "handoff"):
+        """Serialize and remove one decode-phase slot for live
+        migration: the slot's page rows (target + every page group)
+        come back as a host :class:`~.tiers.SlotSnapshot`, its pages
+        return to this pool, and its handle stays OPEN — the stream
+        continues wherever :meth:`attach_slot` lands the snapshot.
+        Returns ``None`` when the request is not currently migratable
+        (unknown, queued, still prefilling). See ``serve/tiers.py``."""
+        from . import tiers as _tiers
+
+        return _tiers.export_slot(self, request_id, reason=reason)
+
+    def attach_slot(self, snap, _handle_factory=None):
+        """Adopt a migrated slot: allocate its page set, write the
+        snapshot's rows (eager indexing like ``_apply_cow`` — zero new
+        step programs), and seat it directly in decode phase. Returns
+        the new handle (``_handle_factory`` substitutes the fleet's
+        relay, exactly like :meth:`submit`). Raises
+        :class:`~.tiers.TierMigrationError` /
+        :class:`~.scheduler.QueueFullError` /
+        :class:`~..utils.failures.PagePoolExhausted` with the engine
+        untouched — the caller's fallback still owns the request."""
+        from . import tiers as _tiers
+
+        return _tiers.restore_slot(self, snap, _handle_factory=_handle_factory)
+
     # -- supervision -------------------------------------------------------
 
     def inject_fault(self, error: BaseException) -> None:

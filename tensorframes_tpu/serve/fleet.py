@@ -86,8 +86,10 @@ from ..utils.failures import (
 )
 from ..utils.logging import get_logger
 from . import tenancy as _tenancy
+from . import tiers as _tiers
 from .engine import EngineUnhealthyError, GenerationEngine
 from .scheduler import GenerationHandle, QueueFullError
+from .tiers import TIERS, TierMigrationError
 
 __all__ = ["Fleet", "FleetHandle"]
 
@@ -122,6 +124,12 @@ _m_placements = _counter(
     "fleet.placements_total",
     "Requests placed by the router, by chosen replica",
     labels=("replica",),
+)
+_m_tier_replicas = _gauge(
+    "fleet.tier_replicas_active",
+    "Replicas currently accepting placements, by tier role "
+    "(prefill / decode / mixed — see serve/tiers.py)",
+    labels=("tier",),
 )
 
 #: session-affinity map bound: beyond this many distinct sessions the
@@ -227,9 +235,20 @@ class _RelayHandle(GenerationHandle):
         # check-then-forward could pass the gate, stall, and deliver
         # after a replay snapshot — the duplicated-position corruption
         # the gate exists to prevent
+        first = len(self._tokens) == 1
         with self._rec.lock:
             if self._rec.inner is self:
                 self._rec.handle._emit(token)
+            else:
+                first = False
+        if first and not self._done.is_set():
+            # first live token from THIS relay: on a prefill-tier
+            # replica that is the handoff point — prefill work is done,
+            # every decode step from here on belongs on the decode
+            # tier. Enqueue only; the router tick does the migration
+            # (this runs on the engine's stepping thread, step lock
+            # held — it must stay O(1)).
+            self._fleet._maybe_handoff(self._rec)
 
     def _finish(self, error: Optional[BaseException] = None) -> None:
         super()._finish(error)
@@ -253,15 +272,23 @@ class _Replica:
     ``restart()`` would block on the lock the wedged step still holds;
     recycle the process."""
 
-    __slots__ = ("name", "engine", "state", "wedged", "restarting", "lock")
+    __slots__ = (
+        "name", "engine", "state", "wedged", "restarting", "lock", "tier",
+    )
 
-    def __init__(self, name: str, engine: GenerationEngine):
+    def __init__(self, name: str, engine: GenerationEngine, tier: str = "mixed"):
         self.name = name
         self.engine = engine
         self.state = "active"
         self.wedged = False
         self.restarting = False
         self.lock = threading.Lock()
+        #: placement role (``serve/tiers.py``): ``prefill`` replicas take
+        #: new requests and hand streams off at first token; ``decode``
+        #: replicas receive migrated streams; ``mixed`` (the default) does
+        #: both — a fleet of all-mixed replicas behaves exactly as before
+        #: tiering existed.
+        self.tier = tier
 
 
 class Fleet:
@@ -304,7 +331,12 @@ class Fleet:
       model + construction kwargs: the elastic-membership door
       (``serve/membership.py``) where the router fronts remote-replica
       adapters it did not construct and the roster grows/shrinks at
-      runtime as members register and resign.
+      runtime as members register and resign;
+    - ``tiers`` — one role label per replica (``prefill`` / ``decode``
+      / ``mixed``): the disaggregated-serving door (``serve/tiers.py``).
+      New requests place on prefill-capable replicas and migrate to the
+      decode tier at first token via live KV-page handoff; all-``mixed``
+      (the default) is the monolithic fleet, byte-for-byte.
     """
 
     def __init__(
@@ -320,6 +352,7 @@ class Fleet:
         auto_restart: bool = True,
         replica_kwargs: Optional[Sequence[Dict]] = None,
         engines: Optional[Sequence[Tuple[str, object]]] = None,
+        tiers: Optional[Sequence[str]] = None,
         **engine_kwargs,
     ):
         if engines is not None:
@@ -401,6 +434,25 @@ class Fleet:
                 )
                 for i in range(int(replicas))
             ]
+        if tiers is not None:
+            # one tier label per replica, roster order — the
+            # disaggregated-serving door (serve/tiers.py): ``prefill``
+            # replicas take new requests and hand each stream off at
+            # first token; ``decode`` replicas receive the migrated
+            # streams. All-``mixed`` (the default) is the monolithic
+            # fleet, byte-for-byte.
+            if len(tiers) != len(self._replicas):
+                raise ValueError(
+                    f"tiers= has {len(tiers)} labels for "
+                    f"{len(self._replicas)} replicas — one per replica"
+                )
+            for t in tiers:
+                if t not in TIERS:
+                    raise ValueError(
+                        f"unknown tier {t!r}; expected one of {TIERS}"
+                    )
+            for rep, t in zip(self._replicas, tiers):
+                rep.tier = str(t)
         self.watchdog_interval_s = float(watchdog_interval_s)
         self.wedge_timeout_s = float(wedge_timeout_s)
         self.probe_timeout_s = float(probe_timeout_s)
@@ -412,6 +464,17 @@ class Fleet:
         self._req_counter = 0
         self._inflight: Dict[int, _FleetRequest] = {}
         self._pending: Deque[_FleetRequest] = deque()
+        #: first-token handoff queue (serve/tiers.py): records whose
+        #: stream just produced its first token on a ``prefill``-tier
+        #: replica, awaiting migration to a decode-capable replica on
+        #: the next router tick. Drained by :meth:`_drain_migrations`.
+        self._handoff: Deque[_FleetRequest] = deque()
+        #: pool-pressure rebalance queue: ``(snapshot, rec, dst_name)``
+        #: triples detached synchronously by the on_pressure hook (on
+        #: the source engine's stepping thread) and imported
+        #: asynchronously here — the split keeps the source step lock
+        #: and the destination step lock from ever nesting.
+        self._imports: Deque[Tuple[object, _FleetRequest, str]] = deque()
         #: session key -> (pinned replica, tenant) — the tenant rides
         #: along so the SLO actuator can drop one tenant's pins
         #: (:meth:`replace_tenant_sessions`) without scanning requests
@@ -476,6 +539,7 @@ class Fleet:
             h = rep.engine.health()
             h["state"] = rep.state
             h["wedged"] = rep.wedged
+            h["tier"] = rep.tier
             reps[rep.name] = h
             if rep.state == "active" and h["healthy"]:
                 healthy += 1
@@ -512,6 +576,7 @@ class Fleet:
         self,
         session: Optional[str] = None,
         tenant: Optional[str] = None,
+        role: str = "new",
     ) -> List[_Replica]:
         """Active, healthy replicas in placement-preference order:
         session-affine replica first (when mapped and still eligible),
@@ -520,9 +585,20 @@ class Fleet:
         a tenant named, replicas holding FEWER of that tenant's active
         slots come first (ahead of raw load): one tenant's flood piles
         onto the replicas it already occupies instead of spreading to
-        monopolize every pool. Raises :class:`EngineUnhealthyError`
-        when every replica is fenced — the ALL-replicas-down shed the
-        endpoint maps to 503."""
+        monopolize every pool.
+
+        ``role`` applies the tier preference (serve/tiers.py) as the
+        LEADING sort key — a soft preference, never a filter, so a
+        fleet whose preferred tier is entirely fenced degrades to
+        placing on whatever is healthy rather than shedding:
+
+        - ``"new"`` — fresh placements prefer ``prefill`` + ``mixed``
+          replicas (prefill capacity is what new requests consume);
+        - ``"decode"`` — migration targets prefer ``decode`` +
+          ``mixed`` replicas.
+
+        Raises :class:`EngineUnhealthyError` when every replica is
+        fenced — the ALL-replicas-down shed the endpoint maps to 503."""
         _chaos.site("fleet.place")
         cands = [
             rep
@@ -536,9 +612,17 @@ class Fleet:
                 "all fleet replicas are fenced or unhealthy; the watchdog "
                 "is restarting them — retry shortly"
             )
+        preferred = (
+            ("prefill", "mixed") if role == "new" else ("decode", "mixed")
+        )
+
+        def _tier_rank(rep: _Replica) -> int:
+            return 0 if rep.tier in preferred else 1
+
         if tenant and _tenancy.enabled():
             cands.sort(
                 key=lambda rep: (
+                    _tier_rank(rep),
                     self._tenant_slots(rep, tenant),
                     -rep.engine.pool.pages_free,
                     rep.engine.scheduler.queue_depth,
@@ -548,6 +632,7 @@ class Fleet:
         else:
             cands.sort(
                 key=lambda rep: (
+                    _tier_rank(rep),
                     -rep.engine.pool.pages_free,
                     rep.engine.scheduler.queue_depth,
                     rep.name,
@@ -1049,6 +1134,267 @@ class Fleet:
             with self._lock:
                 self._pending.extendleft(reversed(parked))
 
+    # -- live KV-page migration (serve/tiers.py) ---------------------------
+
+    def _maybe_handoff(self, rec: _FleetRequest) -> None:
+        """Queue ``rec`` for tier handoff if its stream just produced
+        its first token on a ``prefill``-tier replica. Called from the
+        relay's ``_emit`` — the SOURCE engine's stepping thread, step
+        lock held — so this only enqueues; the router tick migrates."""
+        if self._closed or not get_config().tier_handoff:
+            return
+        rep = rec.replica
+        if rep is None or rep.tier != "prefill":
+            return
+        with self._lock:
+            self._handoff.append(rec)
+        self._wake.set()
+
+    def _drain_migrations(self) -> None:
+        """One router tick's worth of migrations: first-token handoffs
+        off prefill replicas, then pool-pressure rebalance imports
+        parked by the on_pressure hook."""
+        with self._lock:
+            handoffs = list(self._handoff)
+            self._handoff.clear()
+            imports = list(self._imports)
+            self._imports.clear()
+        for rec in handoffs:
+            try:
+                self._migrate_handoff(rec)
+            except Exception:
+                logger.exception(
+                    "fleet: handoff of request %d failed unexpectedly",
+                    rec.request_id,
+                )
+        for snap, rec, dst_name in imports:
+            try:
+                self._import_slot(snap, rec, dst_name)
+            except Exception:
+                logger.exception(
+                    "fleet: rebalance import of request %d failed "
+                    "unexpectedly",
+                    rec.request_id,
+                )
+
+    def _migrate_handoff(self, rec: _FleetRequest) -> None:
+        """Move one just-prefilled stream from its prefill-tier replica
+        to a decode-capable one: export the slot's KV pages (host
+        bytes), retire the source relay, restore on the destination.
+        Failure BEFORE the export is a no-op (the stream keeps decoding
+        where it is); failure AFTER falls back to the recompute-style
+        replay path — the same ladder a replica death uses — so the
+        caller's stream survives either way, byte-identical."""
+        src = rec.replica
+        inner = rec.inner
+        if (
+            rec.handle.done
+            or src is None
+            or src.tier != "prefill"
+            or inner is None
+            or not hasattr(src.engine, "detach_slot")
+        ):
+            return
+        try:
+            # the chaos window for the fleet-level migration decision;
+            # transient faults retry invisibly, a fatal one aborts the
+            # handoff before any pages moved (stream unaffected)
+            run_with_retries(
+                lambda: _chaos.site("fleet.migrate"), what="fleet.migrate"
+            )
+            dsts = [
+                rep
+                for rep in self._candidates(
+                    rec.session, rec.tenant or None, role="decode"
+                )
+                if rep is not src and hasattr(rep.engine, "attach_slot")
+            ]
+            if not dsts:
+                return  # no decode capacity: keep decoding on prefill
+            snap = src.engine.detach_slot(inner.request_id, reason="handoff")
+        except Exception as e:
+            # nothing was detached — the slot still lives at the source
+            # and keeps streaming; log and count the aborted attempt
+            _tiers._m_migrations.inc(reason="aborted")
+            logger.warning(
+                "fleet: handoff of request %d aborted before export "
+                "(%s: %s); stream continues on %s",
+                rec.request_id, type(e).__name__,
+                str(e).split("\n", 1)[0][:120], src.name,
+            )
+            return
+        if snap is None:
+            return  # already finished / preempted / not migratable
+        self._place_snapshot(snap, rec, inner, dsts, reason="handoff")
+
+    def _import_slot(self, snap, rec: _FleetRequest, dst_name: str) -> None:
+        """Land one rebalance snapshot (detached synchronously by the
+        on_pressure hook) on its chosen destination, least-loaded
+        fallbacks behind it."""
+        inner = rec.inner
+        try:
+            dsts = [
+                rep
+                for rep in self._candidates(
+                    rec.session, rec.tenant or None, role="decode"
+                )
+                if rep.name != snap.source
+                and hasattr(rep.engine, "attach_slot")
+            ]
+        except EngineUnhealthyError:
+            dsts = []
+        # the hook's chosen destination goes first if still eligible
+        dsts.sort(key=lambda rep: rep.name != dst_name)
+        self._place_snapshot(snap, rec, inner, dsts, reason="rebalance")
+
+    def _place_snapshot(
+        self,
+        snap,
+        rec: _FleetRequest,
+        inner: Optional[_RelayHandle],
+        dsts: List[_Replica],
+        reason: str,
+    ) -> None:
+        """The import half of a migration: the snapshot's pages are OFF
+        the source (freed), so the stream MUST land somewhere — try
+        each destination, and when none takes it, fall back to the
+        replay queue (recompute-style, same as a replica death). The
+        source relay is retired first so a stale late emission from the
+        source engine cannot race the destination's stream."""
+        with rec.lock:
+            if rec.inner is inner:
+                rec.inner = None
+        for dst in dsts:
+            try:
+                dst.engine.attach_slot(
+                    snap,
+                    _handle_factory=lambda rid: _RelayHandle(rid, self, rec),
+                )
+            except Exception as e:
+                logger.warning(
+                    "fleet: migration of request %d to %s failed (%s: "
+                    "%s); trying next destination",
+                    rec.request_id, dst.name, type(e).__name__,
+                    str(e).split("\n", 1)[0][:120],
+                )
+                continue
+            rec.replica = dst
+            if rec.session is not None:
+                self._remember_session(rec.session, dst, rec.tenant)
+            with _use_trace(rec.trace):
+                _trace_event(
+                    "fleet.migrate",
+                    request=rec.request_id,
+                    source=snap.source,
+                    replica=dst.name,
+                    reason=reason,
+                    pages=snap.n_pages,
+                    emitted=len(rec.handle._tokens),
+                )
+            _flight.record(
+                "fleet", "migrate", request=rec.request_id,
+                source=snap.source, replica=dst.name, reason=reason,
+                pages=snap.n_pages,
+            )
+            logger.info(
+                "fleet: request %d migrated %s -> %s (%s, %d page(s), "
+                "%d token(s) emitted)",
+                rec.request_id, snap.source, dst.name, reason,
+                snap.n_pages, len(rec.handle._tokens),
+            )
+            return
+        # no destination took the pages — recompute-style fallback:
+        # park the record for the ordinary replay drain (prompt + the
+        # tokens already emitted re-prefill elsewhere, byte-identical)
+        _tiers._m_migrations.inc(reason="failed")
+        rec.last_error = TierMigrationError(
+            f"no destination accepted the migrated pages of request "
+            f"{rec.request_id}; replaying recompute-style"
+        )
+        rec.parked_t = time.monotonic()
+        logger.warning(
+            "fleet: migration of request %d found no destination; "
+            "falling back to recompute replay", rec.request_id,
+        )
+        with self._lock:
+            self._pending.append(rec)
+        self._wake.set()
+
+    def _on_pool_pressure(self, rep: _Replica, victim_idx: int) -> bool:
+        """The scheduler's ``on_pressure`` hook (serve/tiers.py door):
+        under KV-pool pressure on ``rep``, try to MIGRATE the chosen
+        victim's slot to a less-loaded decode-capable replica instead
+        of preempting it. Runs on the source engine's stepping thread
+        with the (re-entrant) step lock held: the export is synchronous
+        (it frees the victim's pages, which is the whole point — the
+        caller retries its reservation on True), but the import is
+        parked for the router tick so the two engines' step locks never
+        nest. Returns False for ANY reason migration can't proceed —
+        the grow ladder falls back to preemption, exactly as before."""
+        if (
+            self._closed
+            or self._thread is None
+            or not get_config().tier_rebalance
+        ):
+            return False
+        eng = rep.engine
+        act = eng.scheduler.slots[victim_idx]
+        if act is None or not act.generated or act.cow_src is not None:
+            return False
+        rec = getattr(act.req.handle, "_rec", None)
+        if rec is None or rec.handle.done or rec.inner is not act.req.handle:
+            return False
+        need = len(act.seq.pages)
+        try:
+            cands = [
+                r
+                for r in self._candidates(None, None, role="decode")
+                if r is not rep
+                and hasattr(r.engine, "attach_slot")
+                and r.engine.page_size == eng.page_size
+                and r.engine.pool.pages_free > need
+                and any(s is None for s in r.engine.scheduler.slots)
+            ]
+        except EngineUnhealthyError:
+            return False
+        if not cands:
+            return False
+        try:
+            run_with_retries(
+                lambda: _chaos.site("fleet.migrate"), what="fleet.migrate"
+            )
+            snap = eng.detach_slot(act.req.request_id, reason="rebalance")
+        except Exception as e:
+            logger.warning(
+                "fleet: rebalance export on %s aborted (%s); preempting "
+                "instead", rep.name, type(e).__name__,
+            )
+            return False
+        if snap is None:
+            return False
+        with self._lock:
+            self._imports.append((snap, rec, cands[0].name))
+        self._wake.set()
+        logger.info(
+            "fleet: pool pressure on %s — slot %d (request %d) exported "
+            "for rebalance to %s instead of preemption",
+            rep.name, victim_idx, rec.request_id, cands[0].name,
+        )
+        return True
+
+    def _install_pressure_hook(self, rep: _Replica) -> None:
+        """Point ``rep``'s scheduler at the fleet's migrate-not-preempt
+        ladder rung. Local engines only — a remote-replica adapter has
+        no scheduler here (its own process installs its own hook)."""
+        sched = getattr(rep.engine, "scheduler", None)
+        if sched is None or not hasattr(rep.engine, "detach_slot"):
+            return
+        sched.on_pressure = (
+            lambda victim_idx, _rep=rep: self._on_pool_pressure(
+                _rep, victim_idx
+            )
+        )
+
     # -- health gating -----------------------------------------------------
 
     def _fence(
@@ -1224,11 +1570,33 @@ class Fleet:
         self._wake.set()
         return True
 
-    def _add_replica(self, name: str, engine) -> None:
+    def set_replica_tier(self, name: str, tier: str) -> None:
+        """Re-role one replica at runtime (serve/tiers.py): the
+        membership layer applies a joining member's advertised tier
+        here, and an operator can re-shape a live fleet (e.g. grow the
+        decode tier for a long-output workload) without restarts.
+        In-flight streams are untouched — only FUTURE placements and
+        handoffs see the new role."""
+        if tier not in TIERS:
+            raise ValueError(f"unknown tier {tier!r}; expected one of {TIERS}")
+        rep = self._replica(name)
+        if rep.tier == tier:
+            return
+        old, rep.tier = rep.tier, tier
+        _flight.record(
+            "fleet", "retier", replica=rep.name, tier=tier, was=old
+        )
+        logger.info("fleet: replica %s re-roled %s -> %s", rep.name, old, tier)
+        self._wake.set()
+
+    def _add_replica(self, name: str, engine, tier: str = "mixed") -> None:
         """Grow the roster by one pre-built engine (a member joining the
         elastic fleet). Copy-on-write rebind: concurrent placement and
         watchdog sweeps keep iterating their snapshot."""
-        rep = _Replica(str(name), engine)
+        if tier not in TIERS:
+            raise ValueError(f"unknown tier {tier!r}; expected one of {TIERS}")
+        rep = _Replica(str(name), engine, tier=tier)
+        self._install_pressure_hook(rep)
         with self._lock:
             if any(r.name == rep.name for r in self._replicas):
                 raise ValueError(f"replica {rep.name!r} already exists")
@@ -1379,6 +1747,17 @@ class Fleet:
             _m_rep_queue.set(float(h["queue_depth"]), replica=rep.name)
             _m_rep_pages.set(float(h["pages_in_use"]), replica=rep.name)
         _m_replicas_healthy.set(float(healthy))
+        for tier in TIERS:
+            _m_tier_replicas.set(
+                float(
+                    sum(
+                        1
+                        for rep in self._replicas
+                        if rep.state == "active" and rep.tier == tier
+                    )
+                ),
+                tier=tier,
+            )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1391,6 +1770,7 @@ class Fleet:
         self._stop_evt.clear()
         self._wake.clear()
         for rep in self._replicas:
+            self._install_pressure_hook(rep)
             if rep.engine._thread is None:
                 rep.engine.start()
         self._thread = threading.Thread(target=self._supervise, daemon=True)
@@ -1414,6 +1794,7 @@ class Fleet:
                         logger.warning(
                             "fleet: tick hook %r failed", hook, exc_info=True
                         )
+                self._drain_migrations()
                 self._drain_failovers()
                 self._wake.wait(self.watchdog_interval_s)
                 self._wake.clear()
@@ -1463,6 +1844,8 @@ class Fleet:
             recs = list(self._inflight.values())
             self._inflight.clear()
             self._pending.clear()
+            self._handoff.clear()
+            self._imports.clear()
         err = RuntimeError("fleet stopped with the request in flight")
         for rec in recs:
             rec.handle._finish(err)  # no-op on already-settled handles

@@ -897,13 +897,20 @@ class MemberAgent:
         host: str = "127.0.0.1",
         port: int = 0,
         drain_timeout_s: float = 30.0,
+        tier: str = "mixed",
         server_kwargs: Optional[Dict[str, Any]] = None,
     ):
         from ..interop.serving import ScoringServer
+        from .tiers import TIERS
 
+        if tier not in TIERS:
+            raise ValueError(f"unknown tier {tier!r}; expected one of {TIERS}")
         self.engine = engine
         self.registry = registry
         self.name = name
+        #: advertised placement role (serve/tiers.py), carried in the
+        #: lease metadata so routers apply it on join without polling
+        self.tier = str(tier)
         self.drain_timeout_s = float(drain_timeout_s)
         self._state = "ready"
         self._state_lock = threading.Lock()
@@ -1033,6 +1040,7 @@ class MemberAgent:
             "url": f"{host}:{port}",
             "pid": os.getpid(),
             "state": "ready",
+            "tier": self.tier,
             "eos_id": getattr(self.engine, "eos_id", None),
             "max_seq_len": getattr(self.engine, "max_seq_len", 2048),
         }
@@ -1231,13 +1239,30 @@ class _MemberSync:
                     )
                 except Exception:
                     pass  # duck-typed factory engine without the attr
+                tier = str(view.meta.get("tier", "mixed") or "mixed")
                 try:
-                    fleet._add_replica(name, eng)
+                    fleet._add_replica(name, eng, tier=tier)
                 except ValueError:
-                    continue  # raced another sync pass
+                    # raced another sync pass, or the member advertises
+                    # a tier label this router does not know — join it
+                    # untiered rather than strand its capacity
+                    if name not in fleet.replica_names:
+                        try:
+                            fleet._add_replica(name, eng)
+                        except ValueError:
+                            continue
                 if state != "ready":
                     fleet.drain_replica(name)
                 continue
+            try:
+                # a member may re-role between heartbeats (operator
+                # re-shaping the tiers); apply it like any other
+                # metadata transition
+                fleet.set_replica_tier(
+                    name, str(view.meta.get("tier", "mixed") or "mixed")
+                )
+            except (KeyError, ValueError):
+                pass
             rep_state = fleet.replica_state(name)
             if state == "draining" and rep_state == "active":
                 fleet.drain_replica(name)

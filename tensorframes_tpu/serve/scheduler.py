@@ -250,6 +250,15 @@ class Scheduler:
         #: per-request cost attribution here (page count, token totals)
         #: without the scheduler importing any observability
         self.on_request_done = None
+        #: optional ``fn(victim_idx) -> bool`` consulted by :meth:`grow`
+        #: BEFORE preempting a pool-pressure victim: return True after
+        #: having freed the victim's pages some other way (the fleet
+        #: installs live KV-page migration here, ``serve/tiers.py`` —
+        #: the victim's stream continues on another replica instead of
+        #: paying a recompute-style preemption). False, an exception,
+        #: or no hook falls through to :meth:`preempt` — preemption is
+        #: always the fallback, never removed.
+        self.on_pressure = None
 
     # -- admission ---------------------------------------------------------
 
@@ -420,6 +429,19 @@ class Scheduler:
                     # preempted, which cannot happen with slot-owned pages
                     self.preempt(idx)
                     return False
+                if self.on_pressure is not None:
+                    try:
+                        if self.on_pressure(victim_idx):
+                            # the victim's pages were freed by migration
+                            # (its stream continues elsewhere) — retry
+                            # the reservation before preempting anyone
+                            continue
+                    except Exception:
+                        # a broken hook degrades to the ladder it
+                        # fronts; it must never wedge the step loop
+                        pass
+                if self.slots[victim_idx] is None:
+                    continue  # the hook consumed the victim after all
                 self.preempt(victim_idx)
 
     def _youngest_active(self, exclude: int) -> Optional[int]:
@@ -500,6 +522,24 @@ class Scheduler:
         _tenancy.count_preemption(req.priority)
         self._requeue_front(new_req)
         return new_req
+
+    def detach(self, idx: int) -> _Active:
+        """Release slot ``idx``'s pages WITHOUT closing its handle or
+        requeueing its request — the live-migration release
+        (``serve/tiers.py``): the caller has already serialized the
+        slot's state and will re-materialize it on another replica,
+        where the SAME handle keeps streaming. Unlike :meth:`finish`
+        this runs no terminal accounting (the destination engine
+        accounts the request when it actually finishes) and unlike
+        :meth:`preempt` it records no preemption — nothing was lost.
+        Returns the detached :class:`_Active` for the caller's
+        bookkeeping."""
+        act = self.slots[idx]
+        assert act is not None
+        self._drop_cow(act)
+        act.seq.release()
+        self.slots[idx] = None
+        return act
 
     def _drop_cow(self, act: _Active) -> None:
         """Release a pending copy-on-write donor reference (taken by

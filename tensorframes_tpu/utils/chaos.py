@@ -39,6 +39,17 @@ Named **injection sites** sit on the host-side dispatch paths:
 - ``fleet.place`` — inside the serving fleet's placement path
   (``serve/fleet.py``): a ``transient`` here retries invisibly; a
   ``fatal`` is the router-bug drill
+- ``tier.handoff`` — inside a live KV-page migration's export read and
+  import write retry windows (``serve/tiers.py``): a ``transient``
+  retries the page transfer invisibly (reads are pure; the write
+  re-sets the same rows); a ``fatal`` aborts the migration into the
+  fallback ladder (failover replay / preemption) — the stream survives
+  either way
+- ``fleet.migrate`` — at the head of a fleet-level slot migration
+  (``serve/fleet.py``: tier handoff drain and pool-pressure
+  rebalance): a ``fatal`` is the migration-machinery-bug drill — the
+  request must continue via replay/preemption with no duplicated or
+  lost tokens
 - ``fleet.replica_fault`` — polled once per replica per fleet watchdog
   tick: any raising kind KILLS the replica whose poll fired (device
   state scrambled, every attached handle failed — the hard-process-
@@ -147,6 +158,8 @@ SITES = (
     "fleet.registry",
     "fleet.router_wal",
     "fleet.router_heartbeat",
+    "tier.handoff",
+    "fleet.migrate",
     "tune.trial",
     "tenancy.admit",
 )

@@ -264,6 +264,19 @@ class Config:
     #: already hides behind stream replay. Per-router override:
     #: ``RouterHA(ttl_s=)``.
     router_lease_ttl_s: float = 3.0
+    #: first-token tier handoff (``serve/tiers.py`` +
+    #: ``serve/fleet.py``): in a fleet with prefill/decode tier labels,
+    #: a request prefills on prefill capacity and its KV pages migrate
+    #: to a decode replica once the first token is out. False keeps
+    #: tier labels as a routing preference only (streams stay where
+    #: they prefilled). Irrelevant when every replica is ``mixed``.
+    tier_handoff: bool = True
+    #: pool-pressure rebalancing: before the scheduler preempts a
+    #: victim for pages, the fleet tries migrating the victim's KV
+    #: pages to the least-loaded decode-capable replica instead
+    #: (``Scheduler.on_pressure``). False restores pure
+    #: preempt-youngest. Preemption always remains the fallback.
+    tier_rebalance: bool = True
 
 
 _lock = threading.Lock()

@@ -10,7 +10,15 @@ Requires pyspark (the dedicated CI job installs it); skipped otherwise.
 import numpy as np
 import pytest
 
-pyspark = pytest.importorskip("pyspark")
+# the ONE expected tier-1 skip: pyspark is not in the base image (it is
+# an optional extra — pyproject `[project.optional-dependencies] spark`)
+# and this environment cannot pip-install it. The dedicated CI job that
+# installs the extra runs this file for real; everywhere else the suite
+# reports exactly "1 skipped" here, and ROADMAP.md tracks it so a second
+# skip appearing is a regression, not noise.
+pyspark = pytest.importorskip(
+    "pyspark", reason="pyspark not installed (optional `spark` extra)"
+)
 
 import tensorframes_tpu as tft
 from tensorframes_tpu.interop.spark import (
